@@ -1,0 +1,76 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+Brand-new design on JAX/XLA/Pallas idioms with the capability surface of
+PaddlePaddle (blueprint: SURVEY.md; reference mounted at /root/reference).
+The public namespace mirrors `import paddle` (ref:
+python/paddle/__init__.py) so reference users find what they expect, while
+everything below is TPU-first: XLA is the kernel library and fuser, GSPMD
+the parallelizer, Pallas the escape hatch for fused attention/normalization.
+"""
+from __future__ import annotations
+
+from .core import autograd as _autograd_mod
+from .core import dtype as _dtype_mod
+from .core.autograd import enable_grad, is_grad_enabled, no_grad, set_grad_enabled
+from .core.device import (
+    CPUPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .core.dtype import (
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    finfo,
+    float16,
+    float32,
+    float64,
+    iinfo,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+    promote_types,
+)
+from .core.flags import get_flags, set_flags
+from .core.random import get_rng_state, seed, set_rng_state
+from .core.tensor import Tensor, to_tensor
+from .ops import *  # noqa: F401,F403
+from .ops import api as _ops_api
+from .ops import tensor_patch as _tensor_patch
+
+_tensor_patch.patch()
+
+from .autograd import grad  # noqa: E402  (needs patched Tensor)
+from . import amp  # noqa: E402
+from . import autograd  # noqa: E402
+from . import framework  # noqa: E402
+from .framework.io_api import load, save  # noqa: E402
+
+# `bool` dtype under its paddle name (shadows builtin only inside namespace)
+bool = bool_
+
+__version__ = "0.1.0"
+
+
+def disable_static(place=None):
+    """Dygraph is the default and only eager mode; kept for API parity."""
+    return None
+
+
+def in_dynamic_mode() -> bool:
+    return True
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False

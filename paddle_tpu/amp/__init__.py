@@ -1,0 +1,10 @@
+from .auto_cast import amp_guard, auto_cast, decorate, white_list, black_list
+from .grad_scaler import AmpScaler, GradScaler
+
+__all__ = [
+    "auto_cast",
+    "amp_guard",
+    "decorate",
+    "GradScaler",
+    "AmpScaler",
+]

@@ -1,0 +1,215 @@
+"""Automatic mixed precision.
+
+ref: python/paddle/amp/auto_cast.py:1018 (auto_cast), :1103 (decorate), and
+the per-op cast lists in python/paddle/amp/amp_lists.py; the C++ hook point
+is the ad_func prologue (fluid/eager/amp_auto_cast.h). Here the hook is
+core.dispatch's `_amp_cast_hook`: every eager op call consults the active
+policy and casts floating inputs before tracing.
+
+On TPU the native low-precision dtype is bfloat16 (no loss scaling needed —
+bf16 has fp32's exponent range), so O1 with dtype='bfloat16' is the default
+and GradScaler degrades to a no-op unless float16 is forced.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+# Op lists mirror amp_lists.py: matmul-class ops run in low precision,
+# numerically-sensitive ops stay fp32, the rest promote to the widest input.
+white_list = {
+    "matmul",
+    "bmm",
+    "mm",
+    "mv",
+    "einsum",
+    "conv1d",
+    "conv2d",
+    "conv3d",
+    "conv1d_transpose",
+    "conv2d_transpose",
+    "conv3d_transpose",
+    "addmm",
+    "linear",
+    "flash_attention",
+    "scaled_dot_product_attention",
+}
+black_list = {
+    "exp",
+    "square",
+    "log",
+    "log2",
+    "log10",
+    "log1p",
+    "mean",
+    "sum",
+    "cos_sim",
+    "softmax",
+    "log_softmax",
+    "softmax_with_cross_entropy",
+    "cross_entropy",
+    "binary_cross_entropy",
+    "sigmoid_cross_entropy_with_logits",
+    "c_softmax_with_cross_entropy",
+    "layer_norm",
+    "group_norm",
+    "instance_norm",
+    "rms_norm",
+    "reduce_sum",
+    "logsumexp",
+    "erfinv",
+    "acos",
+    "asin",
+    "cosh",
+    "tan",
+    "sinh",
+    "atanh",
+    "acosh",
+    "asinh",
+    "pow",
+    "norm",
+    "nll_loss",
+    "kl_div",
+    "cumsum",
+    "cumprod",
+    "prod",
+    "var",
+    "std",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def _amp_hook(op_name, args):
+    if not _state.enabled:
+        return args
+    wl = (white_list | _state.custom_white) - _state.custom_black
+    bl = (black_list | _state.custom_black) - _state.custom_white
+    if _state.level == "O2":
+        target = None if op_name in bl else _state.dtype
+    else:
+        if op_name in wl:
+            target = _state.dtype
+        elif op_name in bl:
+            target = jnp.float32
+        else:
+            return args
+    if target is None:
+        target = jnp.float32
+
+    def cast(v):
+        if isinstance(v, Tensor) and v.dtype.is_floating and v.dtype.name in (
+            "float32",
+            "float16",
+            "bfloat16",
+        ):
+            if v._data.dtype != target:
+                from ..ops import api as ops
+
+                with _disabled():
+                    return ops.cast(v, convert_dtype(target).name)
+        return v
+
+    import jax
+
+    return jax.tree_util.tree_map(
+        cast, args, is_leaf=lambda x: isinstance(x, Tensor)
+    )
+
+
+@contextlib.contextmanager
+def _disabled():
+    prev = _state.enabled
+    _state.enabled = False
+    try:
+        yield
+    finally:
+        _state.enabled = prev
+
+
+dispatch.set_amp_hook(_amp_hook)
+
+
+@contextlib.contextmanager
+def auto_cast(
+    enable=True,
+    custom_white_list=None,
+    custom_black_list=None,
+    level="O1",
+    dtype="bfloat16",
+    use_promote=True,
+):
+    """paddle.amp.auto_cast analogue."""
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"level must be O0/O1/O2, got {level}")
+    prev = (
+        _state.enabled,
+        _state.dtype,
+        _state.level,
+        _state.custom_white,
+        _state.custom_black,
+    )
+    _state.enabled = bool(enable) and level != "O0"
+    _state.dtype = convert_dtype(dtype).jnp_dtype
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (
+            _state.enabled,
+            _state.dtype,
+            _state.level,
+            _state.custom_white,
+            _state.custom_black,
+        ) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weight=None, save_dtype=None):
+    """paddle.amp.decorate: O2 casts model params to the AMP dtype.
+
+    Master weights: optimizers in this framework always keep fp32 state, so
+    master_weight is implicit (the reference's master-grad pass analogue).
+    """
+    if level == "O2":
+        from ..nn.layer import Layer
+
+        model_list = models if isinstance(models, (list, tuple)) else [models]
+        target = convert_dtype(dtype).name
+        for m in model_list:
+            if isinstance(m, Layer):
+                m._amp_dtype = target
+                for p in m.parameters():
+                    if p.dtype.is_floating and p.dtype.name == "float32":
+                        p._data = p._data.astype(convert_dtype(dtype).jnp_dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+def is_auto_cast_enabled() -> bool:
+    return _state.enabled
+
+
+def get_amp_dtype():
+    return convert_dtype(_state.dtype).name

@@ -1,0 +1,32 @@
+"""paddle.autograd analogue (ref: python/paddle/autograd/__init__.py)."""
+from ..core.autograd import (
+    enable_grad,
+    grad,
+    is_grad_enabled,
+    no_grad,
+    run_backward,
+    set_grad_enabled,
+)
+from .py_layer import PyLayer, PyLayerContext
+from .functional import hessian, jacobian, jvp, vjp
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward (ref: python/paddle/autograd/autograd.py)."""
+    run_backward(tensors, grad_tensors=grad_tensors, retain_graph=retain_graph)
+
+
+__all__ = [
+    "backward",
+    "grad",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "PyLayer",
+    "PyLayerContext",
+    "jacobian",
+    "hessian",
+    "jvp",
+    "vjp",
+]

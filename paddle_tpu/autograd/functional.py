@@ -1,0 +1,89 @@
+"""Functional AD: jacobian/hessian/jvp/vjp.
+
+ref: python/paddle/autograd/autograd.py (jacobian/hessian) and
+python/paddle/incubate/autograd/primapi.py (jvp). Delegates to jax.jacrev /
+jax.jacfwd / jax.jvp over functionalized Tensors — the TPU-native path is to
+let XLA differentiate the whole program rather than chain per-op nodes.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+def _functionalize(func, example_inputs):
+    """Wrap a Tensor->Tensor python func as a jax.Array pytree function."""
+
+    def fn(*arrays):
+        ins = [Tensor(a, stop_gradient=False) for a in arrays]
+        out = func(*ins)
+        return jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t,
+            out,
+            is_leaf=lambda x: isinstance(x, Tensor),
+        )
+
+    return fn
+
+
+def _unwrap(xs):
+    if isinstance(xs, Tensor):
+        return (xs._data,), True
+    return tuple(x._data for x in xs), False
+
+
+def jacobian(func, xs, create_graph=False):
+    arrays, single = _unwrap(xs)
+    fn = _functionalize(func, arrays)
+    jac = jax.jacrev(fn, argnums=tuple(range(len(arrays))))(*arrays)
+    wrapped = jax.tree_util.tree_map(Tensor, jac)
+    if single:
+        return wrapped[0] if isinstance(wrapped, (tuple, list)) else wrapped
+    return wrapped
+
+
+def hessian(func, xs, create_graph=False):
+    arrays, single = _unwrap(xs)
+    fn = _functionalize(func, arrays)
+    hes = jax.hessian(fn, argnums=tuple(range(len(arrays))))(*arrays)
+    wrapped = jax.tree_util.tree_map(Tensor, hes)
+    if single:
+        out = wrapped
+        while isinstance(out, (tuple, list)) and len(out) == 1:
+            out = out[0]
+        return out
+    return wrapped
+
+
+def jvp(func, xs, v):
+    arrays, single = _unwrap(xs)
+    tangents, _ = _unwrap(v)
+    fn = _functionalize(func, arrays)
+    out, tangent_out = jax.jvp(fn, arrays, tangents)
+    return (
+        jax.tree_util.tree_map(Tensor, out),
+        jax.tree_util.tree_map(Tensor, tangent_out),
+    )
+
+
+def vjp(func, xs, v=None):
+    arrays, single = _unwrap(xs)
+    fn = _functionalize(func, arrays)
+    out, vjp_fn = jax.vjp(fn, *arrays)
+    if v is None:
+        import jax.numpy as jnp
+
+        cots = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        cots = jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t,
+            v,
+            is_leaf=lambda x: isinstance(x, Tensor),
+        )
+    grads = vjp_fn(cots)
+    wrapped = tuple(Tensor(g) for g in grads)
+    return (
+        jax.tree_util.tree_map(Tensor, out),
+        wrapped[0] if single else wrapped,
+    )

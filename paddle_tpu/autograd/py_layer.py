@@ -1,0 +1,116 @@
+"""PyLayer — user-defined autograd functions.
+
+ref: python/paddle/autograd/py_layer.py:282 over fluid/eager/pylayer/.
+TPU-native version: the custom backward is spliced into the tape as a
+GradNode whose vjp calls `backward` through the dispatcher, so saved
+tensors and higher-order composition behave like any generated op.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core import autograd, dispatch
+from ..core.tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    # paddle exposes it as a method too
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *tensors):
+        self.not_inplace_tensors = tensors
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with autograd.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (tuple, list))
+        out_list = [outputs] if single else list(outputs)
+
+        in_tensors = [
+            a
+            for a in jax.tree_util.tree_leaves(
+                (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor)
+            )
+            if isinstance(a, Tensor)
+        ]
+        requires = autograd.is_grad_enabled() and any(
+            not t.stop_gradient for t in in_tensors
+        )
+        if not requires:
+            return outputs
+
+        tensor_outs = [o for o in out_list if isinstance(o, Tensor)]
+
+        def vjp_fn(cot_tree):
+            cots = cot_tree if isinstance(cot_tree, (tuple, list)) else (cot_tree,)
+            cot_tensors = [
+                Tensor(c) if not isinstance(c, Tensor) else c for c in cots
+            ]
+            grads = cls.backward(ctx, *cot_tensors)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            out = []
+            for g in grads:
+                out.append(g._data if isinstance(g, Tensor) else g)
+            return tuple(out)
+
+        out_flat = [t._data for t in tensor_outs]
+        out_treedef = jax.tree_util.tree_structure(tuple(out_flat))
+        node = autograd.GradNode(
+            f"PyLayer<{cls.__name__}>",
+            vjp_fn,
+            tuple(in_tensors),
+            len(out_flat),
+            out_treedef,
+        )
+        node.out_avals = [(a.shape, a.dtype) for a in out_flat]
+
+        wrapped = []
+        i = 0
+        for o in out_list:
+            if isinstance(o, Tensor):
+                wrapped.append(
+                    Tensor(o._data, stop_gradient=False, _grad_node=node, _out_index=i)
+                )
+                i += 1
+            else:
+                wrapped.append(o)
+        return wrapped[0] if single else tuple(wrapped)
+
+
+# A vjp_fn signature shim: core.dispatch.call_vjp calls node.vjp_fn(cot_tree)
+# directly for PyLayer nodes (fwd_fn is None so create_graph falls back to
+# the residual path).

@@ -1,0 +1,2 @@
+from . import autograd, device, dispatch, dtype, flags, random
+from .tensor import Tensor, to_tensor

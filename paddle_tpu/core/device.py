@@ -1,0 +1,101 @@
+"""Device / place management.
+
+Maps the reference's Place hierarchy (paddle/phi/common/place.h: CPUPlace,
+GPUPlace(id), CustomPlace...) onto PJRT devices exposed through JAX. On TPU
+there are no user-visible streams: XLA schedules; a Place is just a PJRT
+device handle plus a stable string form ("tpu:0", "cpu:0").
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+class Place:
+    __slots__ = ("device_type", "device_id")
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    @property
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _canonical(d.platform) == self.device_type]
+        if not devs:
+            devs = jax.devices("cpu")
+        return devs[self.device_id % len(devs)]
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __str__(self):
+        return f"{self.device_type}:{self.device_id}"
+
+    def __eq__(self, other):
+        if isinstance(other, str):
+            other = parse_device(other)
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+
+def CPUPlace() -> Place:
+    return Place("cpu", 0)
+
+
+def TPUPlace(device_id: int = 0) -> Place:
+    return Place("tpu", device_id)
+
+
+def _canonical(platform: str) -> str:
+    # The axon tunnel reports platform 'axon' for a real TPU chip.
+    if platform in ("tpu", "axon"):
+        return "tpu"
+    return platform
+
+
+@functools.cache
+def _default_device_type() -> str:
+    platforms = {_canonical(d.platform) for d in jax.devices()}
+    return "tpu" if "tpu" in platforms else "cpu"
+
+
+_current_place: Place | None = None
+
+
+def parse_device(device: str) -> Place:
+    if ":" in device:
+        ty, _, idx = device.partition(":")
+        return Place(_canonical(ty), int(idx))
+    return Place(_canonical(device), 0)
+
+
+def set_device(device: str) -> Place:
+    global _current_place
+    _current_place = parse_device(device)
+    return _current_place
+
+
+def get_device() -> str:
+    return str(current_place())
+
+
+def current_place() -> Place:
+    if _current_place is not None:
+        return _current_place
+    return Place(_default_device_type(), 0)
+
+
+def is_compiled_with_tpu() -> bool:
+    return _default_device_type() == "tpu"
+
+
+def device_count(device_type: str | None = None) -> int:
+    ty = device_type or _default_device_type()
+    return len([d for d in jax.devices() if _canonical(d.platform) == ty])

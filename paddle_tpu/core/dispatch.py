@@ -1,0 +1,236 @@
+"""Eager op dispatch.
+
+The analogue of the reference's generated `<op>_ad_func` prologue
+(fluid/eager/auto_code_generator/generator/eager_gen.py: AMP cast → layout
+autotune → dist branch → phi API call → GradNode wiring), collapsed into one
+generic dispatcher because VJPs come from jax.vjp instead of generated
+GradNode classes.
+
+Pipeline per call:
+  1. flatten (Tensor|list[Tensor]|scalar) args, unwrap to jax.Arrays
+  2. AMP autocast hook (amp/auto_cast.py registers the active policy)
+  3. DistTensor branch: if any input carries a placement, route through the
+     distributed dispatcher (spmd rule → reshard → local compute)
+  4. run impl; if grad is required, run it under jax.vjp and record a GradNode
+  5. optional NaN/Inf scan (FLAGS_check_nan_inf)
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd, flags
+from .tensor import Tensor
+
+# Registered by paddle_tpu.amp at import time; None when AMP is off.
+_amp_cast_hook: Callable | None = None
+# Registered by paddle_tpu.distributed; routes DistTensor inputs.
+_dist_dispatch_hook: Callable | None = None
+
+
+def set_amp_hook(fn):
+    global _amp_cast_hook
+    _amp_cast_hook = fn
+
+
+def set_dist_hook(fn):
+    global _dist_dispatch_hook
+    _dist_dispatch_hook = fn
+
+
+def _is_tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+def _tree_flatten_tensors(args):
+    """Flatten nested (tuple/list) args, separating Tensor leaves."""
+    return jax.tree_util.tree_flatten(
+        args, is_leaf=_is_tensor_leaf
+    )
+
+
+def _check_nan_inf(name, arrays):
+    level = flags.get_flag("FLAGS_check_nan_inf_level")
+    for a in arrays:
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            bad = bool(jnp.logical_not(jnp.all(jnp.isfinite(a))))
+            if bad:
+                msg = f"NaN/Inf detected in output of op '{name}'"
+                if level >= 3:
+                    print(f"[check_nan_inf] {msg}")
+                else:
+                    raise FloatingPointError(msg)
+
+
+def call(op_name: str, impl: Callable, args: tuple, attrs: dict[str, Any]):
+    """Dispatch one op eagerly. `args` may contain Tensors, lists of Tensors,
+    and None; `attrs` are static python values closed over the impl."""
+    if _amp_cast_hook is not None:
+        args = _amp_cast_hook(op_name, args)
+
+    flat, treedef = _tree_flatten_tensors(args)
+    tensor_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
+
+    if _dist_dispatch_hook is not None and any(
+        isinstance(flat[i], Tensor) and flat[i].is_dist() for i in tensor_idx
+    ):
+        return _dist_dispatch_hook(op_name, impl, args, attrs)
+
+    in_tensors = [flat[i] for i in tensor_idx]
+    primals = tuple(t._data for t in in_tensors)
+
+    requires_grad = autograd.is_grad_enabled() and any(
+        (not t.stop_gradient) for t in in_tensors
+    )
+
+    def fn(*arrays):
+        rebuilt = list(flat)
+        for i, a in zip(tensor_idx, arrays):
+            rebuilt[i] = a
+        rebuilt_args = jax.tree_util.tree_unflatten(treedef, rebuilt)
+        return impl(*rebuilt_args, **attrs)
+
+    if requires_grad:
+        out, vjp_fn = jax.vjp(fn, *primals)
+    else:
+        out = fn(*primals)
+        vjp_fn = None
+
+    out_flat, out_treedef = jax.tree_util.tree_flatten(out)
+    # float0 leaves (cotangents of integral inputs, from grad-of-grad ops)
+    # carry no information — surface them as None.
+    out_flat = [
+        None
+        if (isinstance(a, np.ndarray) and a.dtype == jax.dtypes.float0)
+        else a
+        for a in out_flat
+    ]
+
+    if flags.get_flag("FLAGS_check_nan_inf"):
+        _check_nan_inf(op_name, [a for a in out_flat if a is not None])
+
+    # Only float/complex outputs participate in AD; an op whose outputs are
+    # all integral (argmax, equal, ...) records nothing.
+    def _is_diff(a):
+        return a is not None and (
+            jnp.issubdtype(a.dtype, jnp.floating)
+            or jnp.issubdtype(a.dtype, jnp.complexfloating)
+        )
+
+    if requires_grad and any(_is_diff(a) for a in out_flat):
+        node = autograd.GradNode(
+            op_name,
+            vjp_fn,
+            tuple(in_tensors),
+            len(out_flat),
+            out_treedef,
+        )
+        node.fwd_fn = fn
+        node.out_avals = [
+            (a.shape, a.dtype) if a is not None else ((), jnp.float32)
+            for a in out_flat
+        ]
+        out_tensors = [
+            Tensor(a, stop_gradient=False, _grad_node=node, _out_index=i)
+            if _is_diff(a)
+            else (Tensor(a, stop_gradient=True) if a is not None else None)
+            for i, a in enumerate(out_flat)
+        ]
+    else:
+        out_tensors = [
+            Tensor(a, stop_gradient=True) if a is not None else None
+            for a in out_flat
+        ]
+
+    result = jax.tree_util.tree_unflatten(out_treedef, out_tensors)
+    return result
+
+
+def _synth_cotangents(node, cotangents):
+    """Full cotangent list: missing entries become zeros (float) or float0
+    (integral outputs, which jax.vjp requires)."""
+    cot_arrays = []
+    for (shape, dtype), c in zip(node.out_avals, cotangents):
+        if c is not None:
+            a = c._data if isinstance(c, Tensor) else c
+            if a.dtype != dtype and jnp.issubdtype(dtype, jnp.floating):
+                a = a.astype(dtype)
+            cot_arrays.append(a)
+        elif jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(
+            dtype, jnp.complexfloating
+        ):
+            cot_arrays.append(jnp.zeros(shape, dtype))
+        else:
+            cot_arrays.append(np.zeros(shape, jax.dtypes.float0))
+    return cot_arrays
+
+
+def _wrap_in_cots(node, in_cots):
+    result = []
+    for t, g in zip(node.inputs, in_cots):
+        if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+            result.append(None)
+        elif isinstance(g, Tensor):
+            result.append(g)
+        else:
+            result.append(Tensor(g, stop_gradient=True))
+    return result
+
+
+def call_vjp(node, cotangents, create_graph=False):
+    """Run a node's vjp. `cotangents`: list (len n_outputs) of Tensor|None.
+
+    Fast path uses the residual closure captured at forward time. The
+    create_graph path instead re-runs jax.vjp *through the dispatcher* with
+    the original forward inputs as op inputs — that is what connects the
+    produced gradients back to the tape for higher-order AD (the reference
+    gets this from generated double_grad nodes, backward.yaml *_double_grad).
+    """
+    if node.vjp_fn is None and node.fwd_fn is None:
+        raise RuntimeError(
+            f"trying to backward through `{node.name}` a second time after its "
+            "graph was freed; call backward(retain_graph=True) the first time"
+        )
+    if create_graph:
+        fwd_fn = node.fwd_fn
+        out_treedef = node.out_treedef
+        n_in = len(node.inputs)
+
+        def grad_op(*args):
+            primal_arrays, cot_arrays = args[:n_in], args[n_in:]
+            _, vjp_fn = jax.vjp(fwd_fn, *primal_arrays)
+            ct = jax.tree_util.tree_unflatten(out_treedef, list(cot_arrays))
+            return tuple(vjp_fn(ct))
+
+        cot_args = []
+        for (shape, dtype), c in zip(node.out_avals, cotangents):
+            if isinstance(c, Tensor):
+                cot_args.append(c)
+            else:
+                arrs = _synth_cotangents(node, cotangents)
+                break
+        else:
+            arrs = None
+        if arrs is not None:
+            cot_args = [
+                c if isinstance(c, Tensor) else a
+                for c, a in zip(cotangents, arrs)
+            ]
+        outs = call(
+            f"{node.name}_grad", grad_op, tuple(node.inputs) + tuple(cot_args), {}
+        )
+        outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        return _wrap_in_cots(node, outs)
+
+    cot_arrays = _synth_cotangents(node, cotangents)
+    cot_tree = jax.tree_util.tree_unflatten(node.out_treedef, cot_arrays)
+    if node.vjp_fn is None:
+        # Graph was partially freed but fwd_fn retained: recompute.
+        _, vjp_fn = jax.vjp(node.fwd_fn, *(t._data for t in node.inputs))
+    else:
+        vjp_fn = node.vjp_fn
+    in_cots = vjp_fn(cot_tree)
+    return _wrap_in_cots(node, in_cots)

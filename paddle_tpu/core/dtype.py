@@ -1,0 +1,133 @@
+"""Dtype system for the TPU-native framework.
+
+Mirrors the reference's dtype surface (paddle.float32, Tensor.dtype, casting
+rules; ref: paddle/phi/common/data_type.h) but is backed directly by JAX/numpy
+dtypes — on TPU the canonical compute dtype is bfloat16 and the canonical
+accumulation dtype is float32.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class DType:
+    """A framework dtype: thin, interned wrapper over a jnp dtype.
+
+    Interned so `x.dtype == paddle_tpu.float32` and identity checks both work.
+    """
+
+    _registry: dict[str, "DType"] = {}
+
+    __slots__ = ("name", "jnp_dtype", "is_floating", "is_integer", "is_complex", "is_bool")
+
+    def __init__(self, name: str, jnp_dtype):
+        self.name = name
+        self.jnp_dtype = jnp.dtype(jnp_dtype)
+        self.is_floating = jnp.issubdtype(self.jnp_dtype, jnp.floating)
+        self.is_integer = jnp.issubdtype(self.jnp_dtype, jnp.integer)
+        self.is_complex = jnp.issubdtype(self.jnp_dtype, jnp.complexfloating)
+        self.is_bool = self.jnp_dtype == jnp.bool_
+        DType._registry[name] = self
+
+    @property
+    def itemsize(self) -> int:
+        return self.jnp_dtype.itemsize
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            try:
+                return self.name == convert_dtype(other).name
+            except (TypeError, ValueError):
+                return False
+        try:
+            return self.jnp_dtype == jnp.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+bool_ = DType("bool", jnp.bool_)
+uint8 = DType("uint8", jnp.uint8)
+int8 = DType("int8", jnp.int8)
+int16 = DType("int16", jnp.int16)
+int32 = DType("int32", jnp.int32)
+int64 = DType("int64", jnp.int64)
+uint16 = DType("uint16", jnp.uint16)
+uint32 = DType("uint32", jnp.uint32)
+uint64 = DType("uint64", jnp.uint64)
+float16 = DType("float16", jnp.float16)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+float32 = DType("float32", jnp.float32)
+float64 = DType("float64", jnp.float64)
+complex64 = DType("complex64", jnp.complex64)
+complex128 = DType("complex128", jnp.complex128)
+try:
+    float8_e4m3fn = DType("float8_e4m3fn", jnp.float8_e4m3fn)
+    float8_e5m2 = DType("float8_e5m2", jnp.float8_e5m2)
+except Exception:  # pragma: no cover - older jax
+    float8_e4m3fn = None
+    float8_e5m2 = None
+
+_ALIASES = {
+    "bool": "bool",
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+}
+
+
+def convert_dtype(dtype) -> DType:
+    """Normalize str/np/jnp/DType to a framework DType."""
+    if dtype is None:
+        raise TypeError("dtype must not be None")
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name in DType._registry:
+            return DType._registry[name]
+    np_dtype = jnp.dtype(dtype)
+    name = np_dtype.name
+    if name in DType._registry:
+        return DType._registry[name]
+    raise TypeError(f"unsupported dtype: {dtype!r}")
+
+
+def to_jnp(dtype) -> jnp.dtype:
+    return convert_dtype(dtype).jnp_dtype
+
+
+# Type-promotion intent mirrors the reference's rules
+# (paddle/phi/common/type_promotion.h) but we delegate the mechanics to
+# jax.numpy's promotion, which is already TPU-canonical.
+def promote_types(a, b) -> DType:
+    return convert_dtype(jnp.promote_types(to_jnp(a), to_jnp(b)))
+
+
+def default_float_dtype() -> DType:
+    from . import flags
+
+    name = flags.get_flag("FLAGS_default_float_dtype")
+    return convert_dtype(name)
+
+
+def is_floating_dtype(dtype) -> bool:
+    return convert_dtype(dtype).is_floating
+
+
+def finfo(dtype):
+    return jnp.finfo(to_jnp(dtype))
+
+
+def iinfo(dtype):
+    return np.iinfo(np.dtype(to_jnp(dtype)))

@@ -1,0 +1,100 @@
+"""Global flag registry.
+
+TPU-native analogue of the reference's flag system
+(paddle/common/flags.cc: 185 PHI_DEFINE_EXPORTED_* flags on a home-grown
+registry in flags_native.cc, env-overridable as FLAGS_*). Same contract:
+  - every flag has a typed default and a help string,
+  - environment variables named after the flag override the default,
+  - `set_flags`/`get_flags` are the programmatic surface.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+_lock = threading.RLock()
+
+
+class _Flag:
+    __slots__ = ("name", "default", "value", "type", "help")
+
+    def __init__(self, name: str, default: Any, help: str):
+        self.name = name
+        self.default = default
+        self.type = type(default)
+        self.help = help
+        self.value = self._from_env()
+
+    def _from_env(self):
+        raw = os.environ.get(self.name)
+        if raw is None:
+            return self.default
+        return _parse(raw, self.type)
+
+
+def _parse(raw: str, ty: type):
+    if ty is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if ty is int:
+        return int(raw)
+    if ty is float:
+        return float(raw)
+    return raw
+
+
+_registry: dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default: Any, help: str = "") -> None:
+    with _lock:
+        if name in _registry:
+            raise ValueError(f"flag {name} already defined")
+        _registry[name] = _Flag(name, default, help)
+
+
+def get_flag(name: str) -> Any:
+    with _lock:
+        return _registry[name].value
+
+
+def set_flags(flags: dict[str, Any]) -> None:
+    """paddle.set_flags analogue."""
+    with _lock:
+        for name, value in flags.items():
+            if name not in _registry:
+                raise KeyError(f"unknown flag: {name}")
+            flag = _registry[name]
+            flag.value = _parse(value, flag.type) if isinstance(value, str) and flag.type is not str else flag.type(value)
+
+
+def get_flags(names) -> dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    with _lock:
+        return {n: _registry[n].value for n in names}
+
+
+def all_flags() -> dict[str, Any]:
+    with _lock:
+        return {n: f.value for n, f in _registry.items()}
+
+
+# ---------------------------------------------------------------------------
+# Core flags (the TPU-relevant subset of the reference's 185).
+# ---------------------------------------------------------------------------
+define_flag("FLAGS_default_float_dtype", "float32", "default dtype for float tensor creation")
+define_flag("FLAGS_check_nan_inf", False, "scan every op output for NaN/Inf (debug net)")
+define_flag("FLAGS_check_nan_inf_level", 0, "0: error on nan/inf; 3: log only")
+define_flag("FLAGS_use_stride_kernel", True, "allow non-contiguous views (kept for API parity)")
+define_flag("FLAGS_eager_jit_ops", True, "compile eager per-op dispatches with jax.jit")
+define_flag("FLAGS_benchmark", False, "block on every op for benchmarking")
+define_flag("FLAGS_amp_dtype", "bfloat16", "default autocast dtype on TPU")
+define_flag("FLAGS_embedding_deterministic", 0, "force deterministic embedding grad")
+define_flag("FLAGS_cudnn_deterministic", False, "API-parity alias for deterministic kernels")
+define_flag("FLAGS_log_level", 0, "framework VLOG level")
+define_flag("FLAGS_allocator_strategy", "auto_growth", "kept for parity; PJRT owns TPU memory")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "parity alias; see XLA_PYTHON_CLIENT_MEM_FRACTION")
+define_flag("FLAGS_use_pallas_kernels", True, "use Pallas kernels (flash-attn, rmsnorm, rope) when on TPU")
+define_flag("FLAGS_jit_donate_buffers", True, "donate input buffers in compiled train steps")
+define_flag("FLAGS_prim_all", False, "decompose ops into primitives before compile")

@@ -1,0 +1,2 @@
+from ..core.random import Generator, get_rng_state, seed, set_rng_state
+from .io_api import load, save

@@ -1,0 +1,66 @@
+"""paddle.save / paddle.load analogue.
+
+ref: python/paddle/framework/io.py:773 (save), :1020 (load). Serialization
+format: a pickle whose Tensor leaves are converted to numpy arrays tagged
+with dtype name, so checkpoints are host-portable and independent of the
+device mesh (bfloat16 round-trips via ml_dtypes).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class _TensorPayload:
+    __slots__ = ("array", "dtype_name", "stop_gradient")
+
+    def __init__(self, array, dtype_name, stop_gradient):
+        self.array = array
+        self.dtype_name = dtype_name
+        self.stop_gradient = stop_gradient
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(
+            np.asarray(obj._local_or_global_data()), obj.dtype.name, obj.stop_gradient
+        )
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Tensor(obj.array, dtype=obj.dtype_name)
+        t.stop_gradient = obj.stop_gradient
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        out = [_unpack(v, return_numpy) for v in obj]
+        return out if isinstance(obj, list) else tuple(out)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,195 @@
+"""Weight initializers (ref: python/paddle/nn/initializer/*).
+
+Each initializer is a callable `(shape, dtype) -> jax.Array`; Layer's
+create_parameter invokes it with a fresh PRNG key from the global
+generator so `paddle.seed` reproduces initializations exactly.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dtype import to_jnp
+from ...core.random import split_key
+
+
+class Initializer:
+    def __call__(self, shape, dtype="float32"):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        return jnp.full(tuple(shape), self.value, dtype=to_jnp(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32"):
+        return self.mean + self.std * jax.random.normal(
+            split_key(), tuple(shape), dtype=to_jnp(dtype)
+        )
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype="float32"):
+        return self.mean + self.std * jax.random.truncated_normal(
+            split_key(), self.a, self.b, tuple(shape), dtype=to_jnp(dtype)
+        )
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype="float32"):
+        return jax.random.uniform(
+            split_key(), tuple(shape), dtype=to_jnp(dtype),
+            minval=self.low, maxval=self.high,
+        )
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [out, in, *spatial] (paddle layout)
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        fin, fout = _fans(shape)
+        fin = self._fan_in or fin
+        fout = self._fan_out or fout
+        std = self.gain * math.sqrt(2.0 / (fin + fout))
+        return std * jax.random.normal(split_key(), tuple(shape), dtype=to_jnp(dtype))
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype="float32"):
+        fin, fout = _fans(shape)
+        fin = self._fan_in or fin
+        fout = self._fan_out or fout
+        limit = self.gain * math.sqrt(6.0 / (fin + fout))
+        return jax.random.uniform(
+            split_key(), tuple(shape), dtype=to_jnp(dtype), minval=-limit, maxval=limit
+        )
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        fin, _ = _fans(shape)
+        fin = self._fan_in or fin
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2)) if self.nonlinearity == "leaky_relu" else math.sqrt(2.0)
+        std = gain / math.sqrt(fin)
+        return std * jax.random.normal(split_key(), tuple(shape), dtype=to_jnp(dtype))
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype="float32"):
+        fin, _ = _fans(shape)
+        fin = self._fan_in or fin
+        gain = math.sqrt(2.0 / (1 + self.negative_slope**2)) if self.nonlinearity == "leaky_relu" else math.sqrt(2.0)
+        limit = gain * math.sqrt(3.0 / fin)
+        return jax.random.uniform(
+            split_key(), tuple(shape), dtype=to_jnp(dtype), minval=-limit, maxval=limit
+        )
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        arr = jnp.asarray(
+            self.value.numpy() if hasattr(self.value, "numpy") else np.asarray(self.value),
+            dtype=to_jnp(dtype),
+        )
+        return arr.reshape(tuple(shape)) if shape else arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype="float32"):
+        return self.gain * jax.nn.initializers.orthogonal()(
+            split_key(), tuple(shape), to_jnp(dtype)
+        )
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype="float32"):
+        out = np.zeros(tuple(shape), dtype=np.float32)
+        oc, ic = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for i in range(min(oc, ic * self.groups)):
+            idx = (i, i % ic) + tuple(centers)
+            out[idx] = 1.0
+        return jnp.asarray(out, dtype=to_jnp(dtype))
+
+
+# paddle historical aliases
+NormalInitializer = Normal
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+MSRA = KaimingNormal
+
+_global_initializer = {"weight": XavierNormal(), "bias": Constant(0.0)}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    _global_initializer["weight"] = weight_init
+    if bias_init is not None:
+        _global_initializer["bias"] = bias_init
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    return gains[nonlinearity]

@@ -1,0 +1,335 @@
+"""Layer — the module base class.
+
+ref: python/paddle/nn/layer/layers.py:353. Same contract: parameter /
+buffer / sublayer registries via __setattr__, forward pre/post hooks,
+train/eval flags, state_dict with structured names. TPU addition:
+`raw_params()` exposes the pytree the jit layer stages into XLA.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from ...core import autograd
+from ...core.dtype import convert_dtype
+from ...core.tensor import Tensor
+from .. import initializer as I
+from ..parameter import Parameter, ParamAttr
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype
+        self._parameters = collections.OrderedDict()
+        self._sub_layers = collections.OrderedDict()
+        self._buffers = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._hook_id = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- construction ------------------------------------------------------
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ):
+        attr = ParamAttr._to_attr(attr)
+        if attr.trainable is False and False:
+            pass
+        dtype = dtype or self._dtype
+        init = (
+            attr.initializer
+            or default_initializer
+            or (I.Constant(0.0) if is_bias else I._global_initializer["weight"])
+        )
+        data = init(shape, dtype=convert_dtype(dtype).name)
+        p = Parameter(data, trainable=attr.trainable, name=attr.name)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- attribute magic ---------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            params[name] = value
+            self.__dict__.pop(name, None)
+            self._sub_layers.pop(name, None)
+            self._buffers.pop(name, None)
+            return
+        subs = self.__dict__.get("_sub_layers")
+        if isinstance(value, Layer):
+            if subs is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            subs[name] = value
+            self.__dict__.pop(name, None)
+            if params is not None:
+                params.pop(name, None)
+            return
+        bufs = self.__dict__.get("_buffers")
+        if bufs is not None and name in bufs:
+            bufs[name] = value
+            return
+        if params is not None and name in params:
+            if value is None:
+                params[name] = None
+                return
+            raise TypeError(f"cannot override parameter {name!r} with non-Parameter")
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for registry in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}"
+        )
+
+    def __delattr__(self, name):
+        for registry in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(registry)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return (
+            list(super().__dir__())
+            + list(self._parameters)
+            + list(self._sub_layers)
+            + list(self._buffers)
+        )
+
+    # -- traversal ---------------------------------------------------------
+    def children(self):
+        for _, layer in self.named_children():
+            yield layer
+
+    def named_children(self):
+        seen = set()
+        for name, layer in self._sub_layers.items():
+            if layer is not None and id(layer) not in seen:
+                seen.add(id(layer))
+                yield name, layer
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, layer in self.named_children():
+            if layer is None or id(layer) in layers_set:
+                continue
+            sub_prefix = prefix + ("." if prefix else "") + name
+            yield from layer.named_sublayers(
+                prefix=sub_prefix, include_self=True, layers_set=layers_set
+            )
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        layers = (
+            self.named_sublayers(prefix=prefix, include_self=True)
+            if include_sublayers
+            else [(prefix, self)]
+        )
+        for layer_prefix, layer in layers:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield layer_prefix + ("." if layer_prefix else "") + name, p
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        layers = (
+            self.named_sublayers(prefix=prefix, include_self=True)
+            if include_sublayers
+            else [(prefix, self)]
+        )
+        for layer_prefix, layer in layers:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield layer_prefix + ("." if layer_prefix else "") + name, b
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # -- modes -------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- call --------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self, include_sublayers=True, structured_name_prefix="", keep_vars=True):
+        out = collections.OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            out[name] = p
+        for layer_prefix, layer in self.named_sublayers(
+            prefix=structured_name_prefix, include_self=True
+        ):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names:
+                    continue
+                out[layer_prefix + ("." if layer_prefix else "") + bname] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        missing, unexpected = [], []
+        own = self.state_dict()
+        consumed = set()
+        with autograd.no_grad():
+            for name, target in own.items():
+                if name in state_dict:
+                    src = state_dict[name]
+                    arr = src._data if isinstance(src, Tensor) else np.asarray(src)
+                    if tuple(np.shape(arr)) != tuple(target._data.shape):
+                        raise ValueError(
+                            f"shape mismatch for {name}: ckpt {np.shape(arr)} vs "
+                            f"model {tuple(target._data.shape)}"
+                        )
+                    import jax.numpy as jnp
+
+                    target._rebind(jnp.asarray(arr, dtype=target._data.dtype))
+                    consumed.add(name)
+                else:
+                    missing.append(name)
+        unexpected = [k for k in state_dict if k not in consumed]
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- dtype/device ------------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        return self._to_impl(device=device, dtype=dtype)
+
+    def _to_impl(self, device=None, dtype=None):
+        import jax
+        import jax.numpy as jnp
+
+        with autograd.no_grad():
+            for t in list(self.parameters()) + list(self.buffers()):
+                arr = t._data
+                if dtype is not None and t.dtype.is_floating:
+                    arr = arr.astype(convert_dtype(dtype).jnp_dtype)
+                if device is not None:
+                    from ...core.device import parse_device
+
+                    arr = jax.device_put(arr, parse_device(device).jax_device)
+                t._rebind(arr)
+        if dtype is not None:
+            self._dtype = convert_dtype(dtype).name
+        return self
+
+    def astype(self, dtype):
+        return self._to_impl(dtype=dtype)
+
+    def float(self):
+        return self._to_impl(dtype="float32")
+
+    def bfloat16(self):
+        return self._to_impl(dtype="bfloat16")
+
+    def half(self):
+        return self._to_impl(dtype="float16")
+
+    # -- misc --------------------------------------------------------------
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, child in self.named_children():
+            child_repr = repr(child).replace("\n", "\n  ")
+            lines.append(f"({name}): {child_repr}")
+        main = self.__class__.__name__ + "(" + extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"

@@ -1,0 +1,73 @@
+"""Parameter & ParamAttr (ref: python/paddle/nn/layer/layers.py create_parameter,
+python/paddle/base/param_attr.py)."""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+class Parameter(Tensor):
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, data, trainable=True, name=None):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda p: ((p._data,), (p.trainable, p._dist_meta)),
+    lambda aux, children: _param_from_pytree(aux, children),
+)
+
+
+def _param_from_pytree(aux, children):
+    p = Parameter.__new__(Parameter)
+    Tensor.__init__(p, children[0], stop_gradient=not aux[0])
+    p.trainable = aux[0]
+    p.persistable = True
+    p.optimize_attr = {"learning_rate": 1.0}
+    p.regularizer = None
+    p.need_clip = True
+    p._dist_meta = aux[1]
+    return p
+
+
+class ParamAttr:
+    """Mirror of paddle.ParamAttr."""
+
+    def __init__(
+        self,
+        name=None,
+        initializer=None,
+        learning_rate=1.0,
+        regularizer=None,
+        trainable=True,
+        do_model_average=True,
+        need_clip=True,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        # an initializer instance
+        return ParamAttr(initializer=attr)

@@ -1,0 +1,228 @@
+"""Public op namespace: generated ops + manual ops.
+
+The manual section covers ops whose python signature can't be expressed in
+the YAML arg grammar (einsum varargs, paddle.normal's overloads, indexing).
+Everything still funnels through core.dispatch.call.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as _dispatch
+from ..core.random import split_key as _split_key
+from ..core.tensor import Tensor
+from ._generated import *  # noqa: F401,F403
+from ._generated import TENSOR_METHOD_TABLE, _inplace_rebind  # noqa: F401
+from ._generated import __all__ as _generated_all
+from ._generated import gaussian, uniform
+
+__all__ = list(_generated_all) + [
+    "einsum",
+    "rand",
+    "randn",
+    "normal",
+    "normal_",
+    "standard_normal",
+    "randint_like",
+    "increment",
+    "getitem",
+    "setitem",
+    "stop_gradient",
+    "exponential_",
+    "bernoulli_",
+    "uniform_",
+    "as_strided",
+    "view",
+    "view_as",
+    "histogramdd",
+    "pca_lowrank",
+    "slogdet_as_tuple",
+]
+
+
+# ---- einsum / linalg extras ----------------------------------------------
+def einsum(equation, *operands):
+    """paddle.einsum (ref: python/paddle/tensor/einsum.py). The MXU workhorse
+    behind attention/MoE contractions — lowered straight to XLA dot_general."""
+
+    def _impl(ops_list):
+        return jnp.einsum(equation, *ops_list)
+
+    return _dispatch.call("einsum", _impl, (list(operands),), {})
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    from .impl import linalg as _linalg
+
+    return _dispatch.call(
+        "histogramdd",
+        _linalg.histogramdd,
+        (x,),
+        {"bins": bins, "ranges": ranges, "density": density, "weights": weights},
+    )
+
+
+def pca_lowrank(x, q=None, center=True, niter=2):
+    from .impl import linalg as _linalg
+
+    return _dispatch.call(
+        "pca_lowrank", _linalg.pca_lowrank, (x,), {"q": q, "center": center, "niter": niter}
+    )
+
+
+def slogdet_as_tuple(x):
+    from ._generated import slogdet
+
+    out = slogdet(x)
+    return out[0], out[1]
+
+
+# ---- random convenience (paddle signatures) ------------------------------
+def rand(shape, dtype=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None):
+    return gaussian(shape, dtype, mean=0.0, std=1.0)
+
+
+def standard_normal(shape, dtype=None):
+    return gaussian(shape, dtype, mean=0.0, std=1.0)
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        from .impl import random as _random
+
+        ref = mean if isinstance(mean, Tensor) else std
+        return _dispatch.call(
+            "normal",
+            lambda m, s, *, key: m + s * jax.random.normal(key, ref._data.shape, ref._data.dtype),
+            (mean, std),
+            {"key": _split_key()},
+        )
+    if shape is None:
+        shape = [1]
+    return gaussian(shape, None, mean=float(mean), std=float(std))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    from ._generated import randint
+
+    return randint(low, high, x.shape, dtype or x.dtype.name)
+
+
+def normal_(x, mean=0.0, std=1.0):
+    from .impl import random as _random
+
+    out = _dispatch.call(
+        "normal_", _random.normal_like, (x,), {"key": _split_key(), "mean": mean, "std": std}
+    )
+    return _inplace_rebind(x, out)
+
+
+def uniform_(x, min=-1.0, max=1.0):
+    from .impl import random as _random
+
+    out = _dispatch.call(
+        "uniform_", _random.uniform_like, (x,), {"key": _split_key(), "min": min, "max": max}
+    )
+    return _inplace_rebind(x, out)
+
+
+def exponential_(x, lam=1.0):
+    from .impl import random as _random
+
+    out = _dispatch.call(
+        "exponential_", _random.exponential, (x,), {"key": _split_key(), "lam": lam}
+    )
+    return _inplace_rebind(x, out)
+
+
+def bernoulli_(x, p=0.5):
+    def _impl(t, *, key, p):
+        return jax.random.bernoulli(key, p, t.shape).astype(t.dtype)
+
+    out = _dispatch.call("bernoulli_", _impl, (x,), {"key": _split_key(), "p": p})
+    return _inplace_rebind(x, out)
+
+
+# ---- misc ----------------------------------------------------------------
+def increment(x, value=1.0):
+    def _impl(t, *, value):
+        return t + value
+
+    out = _dispatch.call("increment", _impl, (x,), {"value": value})
+    return _inplace_rebind(x, out)
+
+
+def stop_gradient(x):
+    return x.detach()
+
+
+def view(x, shape_or_dtype):
+    from .impl import manipulation as _manip
+
+    return _dispatch.call(
+        "view", _manip.view, (x,), {"shape_or_dtype": shape_or_dtype}
+    )
+
+
+def view_as(x, other):
+    return view(x, other.shape)
+
+
+def as_strided(x, shape, stride, offset=0):
+    # stride-based views have no TPU meaning; emulate via gather on flat data
+    def _impl(t, *, shape, stride, offset):
+        flat = t.reshape(-1)
+        idx = jnp.zeros((), dtype=jnp.int32)
+        grids = jnp.meshgrid(
+            *[jnp.arange(s) for s in shape], indexing="ij"
+        )
+        lin = offset
+        for g, st in zip(grids, stride):
+            lin = lin + g * st
+        return flat[lin]
+
+    return _dispatch.call(
+        "as_strided",
+        _impl,
+        (x,),
+        {"shape": tuple(shape), "stride": tuple(stride), "offset": int(offset)},
+    )
+
+
+# ---- indexing ------------------------------------------------------------
+def _convert_index(item):
+    """Normalize a python index expression; Tensor indices -> jax arrays."""
+    if isinstance(item, tuple):
+        return tuple(_convert_index(i) for i in item)
+    if isinstance(item, Tensor):
+        return item._data
+    if isinstance(item, (list,)):
+        return jnp.asarray(item)
+    return item  # int, slice, None, Ellipsis, ndarray, bool
+
+
+def getitem(x, item):
+    idx = _convert_index(item)
+
+    def _impl(t, *, idx):
+        out = t[idx]
+        return out
+
+    return _dispatch.call("getitem", _impl, (x,), {"idx": idx})
+
+
+def setitem(x, item, value):
+    idx = _convert_index(item)
+    if not isinstance(value, Tensor):
+        value = Tensor(value)
+
+    def _impl(t, v, *, idx):
+        return t.at[idx].set(v.astype(t.dtype))
+
+    out = _dispatch.call("setitem", _impl, (x, value), {"idx": idx})
+    return _inplace_rebind(x, out)

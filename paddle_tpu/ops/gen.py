@@ -1,0 +1,283 @@
+"""Op code generator: ops.yaml → _generated.py (+ .pyi stub).
+
+The TPU-native analogue of the reference's single-YAML → N-artifacts build
+(SURVEY §2.13: phi/ops/yaml/ops.yaml feeding api_gen.py, eager_gen.py,
+python_c_gen.py, op_gen.py). Here one entry generates:
+  1. the eager python API function (dispatch wiring, RNG key plumbing,
+     Scalar/IntArray coercion) in `_generated.py`,
+  2. the inplace `<op>_` variant when `inplace:` is declared,
+  3. the Tensor method-patch table (tensor_patch_methods analogue),
+  4. a `.pyi` stub for IDEs.
+
+Run: python -m paddle_tpu.ops.gen   (writes files next to this module)
+
+Entry format:
+  - op: dropout
+    args: (Tensor x, float p=0.5, bool training=True)
+    output: Tensor(out)
+    impl: nn.dropout          # module.func under ops/impl/
+    rng: true                 # draw a PRNG key outside the traced body
+    inplace: true             # also emit dropout_
+    methods: [dropout]        # Tensor methods to patch (default [op])
+    no_method: true           # suppress method patching
+"""
+from __future__ import annotations
+
+import os
+import re
+
+import yaml
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+TENSOR_TYPES = {"Tensor", "Tensor[]", "Tensor?", "Tensor?[]", "Tensor[]?"}
+COERCE = {
+    "IntArray": "_int_array",
+    "Scalar": "_scalar",
+    "DataType": "_dtype_attr",
+}
+
+_ARG_RE = re.compile(
+    r"^\s*(?P<type>[A-Za-z_]+(?:\[\])?\??(?:\[\])?)\s+(?P<name>\w+)"
+    r"(?:\s*=\s*(?P<default>.+?))?\s*$"
+)
+
+
+def _parse_default(tok: str) -> str:
+    t = tok.strip()
+    mapping = {"true": "True", "false": "False", "none": "None", "null": "None"}
+    if t.lower() in mapping:
+        return mapping[t.lower()]
+    if t.startswith("{") and t.endswith("}"):  # {} -> empty list default
+        inner = t[1:-1].strip()
+        return f"[{inner}]" if inner else "[]"
+    if t in ("-inf", "inf"):
+        return f"float('{t}')"
+    return t
+
+
+def parse_args(argstr: str):
+    argstr = argstr.strip()
+    if argstr.startswith("(") and argstr.endswith(")"):
+        argstr = argstr[1:-1]
+    params = []
+    depth = 0
+    cur = ""
+    parts = []
+    for ch in argstr:
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur.strip():
+        parts.append(cur)
+    for p in parts:
+        m = _ARG_RE.match(p.strip())
+        if not m:
+            raise ValueError(f"cannot parse arg: {p!r}")
+        ty, name, default = m.group("type"), m.group("name"), m.group("default")
+        params.append(
+            {
+                "type": ty,
+                "name": name,
+                "default": _parse_default(default) if default is not None else None,
+                "is_tensor": ty in TENSOR_TYPES,
+            }
+        )
+    return params
+
+
+def gen_one(entry) -> tuple[str, str, list[tuple[str, str]]]:
+    op = entry["op"]
+    params = parse_args(entry["args"])
+    impl = entry["impl"]
+    impl_mod, impl_fn = impl.rsplit(".", 1)
+    rng = entry.get("rng", False)
+
+    sig_parts = []
+    for p in params:
+        if p["default"] is not None:
+            sig_parts.append(f"{p['name']}={p['default']}")
+        else:
+            sig_parts.append(p["name"])
+    sig_parts.append("name=None")
+    sig = ", ".join(sig_parts)
+
+    tensor_args = [p["name"] for p in params if p["is_tensor"]]
+    attr_items = []
+    coerce_lines = []
+    for p in params:
+        if p["is_tensor"]:
+            continue
+        fn = COERCE.get(p["type"].rstrip("?"))
+        if fn:
+            coerce_lines.append(f"    {p['name']} = {fn}({p['name']})")
+        attr_items.append(f"'{p['name']}': {p['name']}")
+    if rng:
+        coerce_lines.append("    _key = _split_key()")
+        attr_items.append("'key': _key")
+
+    attrs = "{" + ", ".join(attr_items) + "}"
+    targs = ", ".join(tensor_args)
+    targs_tuple = f"({targs},)" if targs else "()"
+
+    body = [f"def {op}({sig}):"]
+    doc = entry.get("doc")
+    refline = f"  ref: {entry['ref']}" if entry.get("ref") else ""
+    body.append(f'    """{doc or op} (generated from ops.yaml).{refline}"""')
+    body.extend(coerce_lines)
+    body.append(
+        f"    return _call('{op}', _impl_{impl_mod}.{impl_fn}, {targs_tuple}, {attrs})"
+    )
+    fn_src = "\n".join(body)
+
+    extra = ""
+    if entry.get("inplace"):
+        if not tensor_args:
+            raise ValueError(f"inplace op {op} has no tensor arg")
+        first = tensor_args[0]
+        extra = (
+            f"def {op}_({sig}):\n"
+            f'    """Inplace variant of `{op}` (rebinds the payload; jax.Arrays are immutable)."""\n'
+            f"    _out = {op}({', '.join(p['name'] for p in params)})\n"
+            f"    return _inplace_rebind({first}, _out)\n"
+        )
+
+    methods = []
+    if not entry.get("no_method", False):
+        for mname in entry.get("methods", [op]):
+            methods.append((mname, op))
+        if entry.get("inplace"):
+            methods.append((f"{op}_", f"{op}_"))
+    return fn_src, extra, methods
+
+
+HEADER = '''"""AUTO-GENERATED by paddle_tpu/ops/gen.py from ops.yaml — do not edit.
+
+This is artifact (1) of the single-YAML codegen pipeline: the eager op API.
+Every function routes through core.dispatch.call which applies AMP casts,
+the DistTensor branch, jax.vjp tape recording, and NaN/Inf checks.
+"""
+# fmt: off
+from ..core import dispatch as _dispatch
+from ..core.random import split_key as _split_key
+from ..core.tensor import Tensor as _Tensor
+from ..core.dtype import convert_dtype as _convert_dtype
+
+'''
+
+HELPERS = '''
+_call = _dispatch.call
+
+
+def _int_array(v):
+    if v is None:
+        return None
+    if isinstance(v, _Tensor):
+        return [int(i) for i in v.numpy().reshape(-1).tolist()]
+    if isinstance(v, (int,)):
+        return [int(v)]
+    return [int(i) if not isinstance(i, _Tensor) else int(i.item()) for i in v]
+
+
+def _scalar(v):
+    if isinstance(v, _Tensor):
+        return v.item()
+    return v
+
+
+def _dtype_attr(v):
+    if v is None:
+        return None
+    return _convert_dtype(v).name
+
+
+def _inplace_rebind(x, out):
+    from ..core import autograd as _autograd
+
+    if (
+        x.is_leaf
+        and not x.stop_gradient
+        and _autograd.is_grad_enabled()
+    ):
+        raise RuntimeError(
+            "a leaf Tensor that requires grad is being used in an in-place operation"
+        )
+    x._data = out._data
+    x._grad_node = out._grad_node
+    x._out_index = out._out_index
+    x.stop_gradient = out.stop_gradient
+    x._dist_meta = out._dist_meta
+    x._bump_version()
+    return x
+
+'''
+
+
+def generate() -> tuple[str, str]:
+    with open(os.path.join(HERE, "ops.yaml")) as f:
+        entries = yaml.safe_load(f)
+
+    impl_mods = sorted({e["impl"].rsplit(".", 1)[0] for e in entries})
+    imports = "\n".join(
+        f"from .impl import {m} as _impl_{m}" for m in impl_mods
+    )
+
+    fns = []
+    all_methods = []
+    names = []
+    for e in entries:
+        fn_src, extra, methods = gen_one(e)
+        fns.append(fn_src)
+        if extra:
+            fns.append(extra)
+            names.append(e["op"] + "_")
+        names.append(e["op"])
+        all_methods.extend(methods)
+
+    patch_table = "TENSOR_METHOD_TABLE = [\n" + "".join(
+        f"    ({m!r}, {fn!r}),\n" for m, fn in all_methods
+    ) + "]\n"
+    allnames = "__all__ = [\n" + "".join(f"    {n!r},\n" for n in sorted(names)) + "]\n"
+
+    src = (
+        HEADER
+        + imports
+        + "\n"
+        + HELPERS
+        + "\n\n"
+        + "\n\n\n".join(fns)
+        + "\n\n\n"
+        + patch_table
+        + "\n"
+        + allnames
+    )
+
+    pyi_lines = ["from typing import Any\n"]
+    for e in entries:
+        params = parse_args(e["args"])
+        sig = ", ".join(
+            p["name"] + ("=..." if p["default"] is not None else "")
+            for p in params
+        )
+        pyi_lines.append(f"def {e['op']}({sig}, name=...) -> Any: ...")
+    pyi = "\n".join(pyi_lines) + "\n"
+    return src, pyi
+
+
+def main():
+    src, pyi = generate()
+    with open(os.path.join(HERE, "_generated.py"), "w") as f:
+        f.write(src)
+    with open(os.path.join(HERE, "_generated.pyi"), "w") as f:
+        f.write(pyi)
+    print(f"wrote {os.path.join(HERE, '_generated.py')}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,157 @@
+"""Activation op implementations (python/paddle/nn/functional/activation.py).
+
+All lower to XLA elementwise HLO that fuses into neighbouring matmuls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+def leaky_relu(x, *, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope=negative_slope)
+
+
+def prelu(x, weight):
+    w = weight
+    if w.size > 1 and x.ndim > 1:
+        # channel dim is axis 1 (NCHW convention in the reference)
+        shape = [1] * x.ndim
+        shape[1] = w.size
+        w = w.reshape(shape)
+    return jnp.where(x >= 0, x, w * x)
+
+
+def elu(x, *, alpha=1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def selu(x, *, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, *, alpha=1.0):
+    return jax.nn.celu(x, alpha=alpha)
+
+
+def gelu(x, *, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def mish(x):
+    return jax.nn.mish(x)
+
+
+def softplus(x, *, beta=1.0, threshold=20.0):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, (1.0 / beta) * jnp.log1p(jnp.exp(scaled)))
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def softshrink(x, *, threshold=0.5):
+    return jnp.where(
+        x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0)
+    )
+
+
+def hardshrink(x, *, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def hardtanh(x, *, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def hardsigmoid(x, *, slope=0.1666667, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def softmax(x, *, axis=-1, dtype=None):
+    if dtype is not None:
+        from ...core.dtype import to_jnp
+
+        x = x.astype(to_jnp(dtype))
+    return jax.nn.softmax(x, axis=int(axis))
+
+
+def log_softmax(x, *, axis=-1, dtype=None):
+    if dtype is not None:
+        from ...core.dtype import to_jnp
+
+        x = x.astype(to_jnp(dtype))
+    return jax.nn.log_softmax(x, axis=int(axis))
+
+
+def gumbel_softmax(x, *, key, temperature=1.0, hard=False, axis=-1):
+    g = jax.random.gumbel(key, x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+        y = y_hard + jax.lax.stop_gradient(-y) + y  # straight-through
+        y = y_hard - jax.lax.stop_gradient(y) + y
+    return y
+
+
+def glu(x, *, axis=-1):
+    a, b = jnp.split(x, 2, axis=int(axis))
+    return a * jax.nn.sigmoid(b)
+
+
+def maxout(x, *, groups, axis=1):
+    axis = int(axis) % x.ndim
+    c = x.shape[axis]
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1 :]
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+def thresholded_relu(x, *, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+def rrelu(x, *, key, lower=0.125, upper=0.3333333, training=True):
+    if training:
+        a = jax.random.uniform(key, x.shape, dtype=x.dtype, minval=lower, maxval=upper)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, a * x)
+
+
+def swiglu(x, y=None):
+    """ref: python/paddle/incubate/nn/functional/swiglu.py — silu(x) * y,
+    or split-in-half when y is None. The Llama/Mixtral MLP hot path."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y

@@ -1,0 +1,114 @@
+"""Tensor creation op implementations (python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dtype import default_float_dtype, to_jnp
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else default_float_dtype().jnp_dtype
+    return to_jnp(dtype)
+
+
+def zeros(*, shape, dtype=None):
+    return jnp.zeros(tuple(shape), dtype=_dt(dtype))
+
+
+def ones(*, shape, dtype=None):
+    return jnp.ones(tuple(shape), dtype=_dt(dtype))
+
+
+def full(*, shape, fill_value, dtype=None):
+    if dtype is None:
+        import numpy as np
+
+        inferred = np.asarray(fill_value).dtype
+        if inferred == np.float64:
+            inferred = default_float_dtype().jnp_dtype
+        elif inferred == np.int64:
+            inferred = jnp.int32
+        return jnp.full(tuple(shape), fill_value, dtype=inferred)
+    return jnp.full(tuple(shape), fill_value, dtype=_dt(dtype))
+
+
+def empty(*, shape, dtype=None):
+    return jnp.zeros(tuple(shape), dtype=_dt(dtype))
+
+
+def zeros_like(x, *, dtype=None):
+    return jnp.zeros_like(x, dtype=_dt(dtype, x.dtype))
+
+
+def ones_like(x, *, dtype=None):
+    return jnp.ones_like(x, dtype=_dt(dtype, x.dtype))
+
+
+def full_like(x, *, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=_dt(dtype, x.dtype))
+
+
+def empty_like(x, *, dtype=None):
+    return jnp.zeros_like(x, dtype=_dt(dtype, x.dtype))
+
+
+def arange(*, start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        import numpy as np
+
+        if any(isinstance(v, float) for v in (start, end, step)):
+            dtype = default_float_dtype().jnp_dtype
+        else:
+            dtype = jnp.int32
+    else:
+        dtype = to_jnp(dtype)
+    return jnp.arange(start, end, step, dtype=dtype)
+
+
+def linspace(*, start, stop, num, dtype=None):
+    return jnp.linspace(start, stop, int(num), dtype=_dt(dtype))
+
+
+def logspace(*, start, stop, num, base=10.0, dtype=None):
+    return jnp.logspace(start, stop, int(num), base=base, dtype=_dt(dtype))
+
+
+def eye(*, num_rows, num_columns=None, dtype=None):
+    return jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=_dt(dtype))
+
+
+def tril_indices(*, row, col=None, offset=0, dtype="int64"):
+    if col is None:
+        col = row
+    r, c = jnp.tril_indices(int(row), k=int(offset), m=int(col))
+    return jnp.stack([r, c]).astype(jnp.int32)
+
+
+def triu_indices(*, row, col=None, offset=0, dtype="int64"):
+    if col is None:
+        col = row
+    r, c = jnp.triu_indices(int(row), k=int(offset), m=int(col))
+    return jnp.stack([r, c]).astype(jnp.int32)
+
+
+def complex(real, imag):
+    import jax.lax as lax
+
+    return lax.complex(real, imag)
+
+
+def polar(abs, angle):
+    import jax.lax as lax
+
+    return lax.complex(abs * jnp.cos(angle), abs * jnp.sin(angle))
+
+
+def vander(x, *, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def clone(x):
+    return jnp.asarray(x)

@@ -1,0 +1,273 @@
+"""Linear algebra op implementations.
+
+ref API: python/paddle/tensor/linalg.py. Matmuls are the MXU path — always
+expressed as jnp.matmul/einsum so XLA tiles them onto the systolic array;
+`preferred_element_type` keeps bf16 inputs accumulating in f32.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _maybe_transpose_last2(a, flag):
+    if not flag:
+        return a
+    if a.ndim == 1:
+        return a
+    return jnp.swapaxes(a, -1, -2)
+
+
+def matmul(x, y, *, transpose_x=False, transpose_y=False):
+    x = _maybe_transpose_last2(x, transpose_x)
+    y = _maybe_transpose_last2(y, transpose_y)
+    pref = None
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        pref = jnp.float32 if False else None  # XLA default accum is fine
+    return jnp.matmul(x, y, preferred_element_type=pref)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+def mv(x, y):
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    # paddle.dot: 1-D/2-D elementwise-mul + reduce over last dim
+    return jnp.sum(x * y, axis=-1)
+
+
+def t(x):
+    if x.ndim < 2:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+def cross(x, y, *, axis=None):
+    a = 9 if axis is None else int(axis)
+    if axis is None:
+        # paddle: first axis with dim 3
+        for i, s in enumerate(x.shape):
+            if s == 3:
+                a = i
+                break
+    return jnp.cross(x, y, axis=a)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def trace(x, *, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def norm(x, *, p=None, axis=None, keepdim=False):
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord="fro", axis=tuple(axis), keepdims=keepdim)
+    if p == "nuc":
+        return jnp.linalg.norm(x, ord="nuc", axis=tuple(axis), keepdims=keepdim)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    if isinstance(axis, (list, tuple)) and len(axis) == 1:
+        axis = axis[0]
+    if isinstance(axis, (list, tuple)):
+        return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+    p = float(p)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=int(axis), keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=int(axis), keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=int(axis), keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=int(axis), keepdims=keepdim) ** (1.0 / p)
+
+
+def vector_norm(x, *, p=2.0, axis=None, keepdim=False):
+    return jnp.linalg.vector_norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def matrix_norm(x, *, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.matrix_norm(x, ord=p, keepdims=keepdim)
+
+
+def dist(x, y, *, p=2.0):
+    return norm(x - y, p=p)
+
+
+def cholesky(x, *, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+def cholesky_solve(x, y, *, upper=False):
+    import jax.scipy.linalg as jsl
+
+    return jsl.cho_solve((y, not upper), x)
+
+
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, *, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def triangular_solve(x, y, *, upper=True, transpose=False, unitriangular=False):
+    import jax.scipy.linalg as jsl
+
+    a = jnp.swapaxes(x, -1, -2) if transpose else x
+    return jsl.solve_triangular(
+        a, y, lower=not upper if not transpose else upper, unit_diagonal=unitriangular
+    )
+
+
+def lstsq(x, y, *, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def svd(x, *, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+
+def svdvals(x):
+    return jnp.linalg.svdvals(x)
+
+
+def qr(x, *, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def eig(x):
+    return jnp.linalg.eig(x)
+
+
+def eigh(x, *, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvalsh(x, *, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def matrix_power(x, *, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_rank(x, *, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    s, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([s, logdet])
+
+
+def lu(x, *, pivot=True):
+    import jax.scipy.linalg as jsl
+
+    lu_mat, piv = jsl.lu_factor(x)
+    return lu_mat, piv.astype(jnp.int32) + 1  # paddle returns 1-based pivots
+
+
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(list(xs))
+
+
+def histogram(x, weight=None, *, bins=100, min=0, max=0, density=False):
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(
+        x.reshape(-1), bins=bins, range=(lo, hi), weights=weight, density=density
+    )
+    return hist
+
+
+def histogramdd(x, *, bins=10, ranges=None, density=False, weights=None):
+    hist, edges = jnp.histogramdd(x, bins=bins, range=ranges, density=density, weights=weights)
+    return (hist, *edges)
+
+
+def bincount(x, weights=None, *, minlength=0):
+    length = max(int(jnp.max(x).item()) + 1 if x.size else 0, minlength)
+    return jnp.bincount(x.reshape(-1), weights=weights, length=length)
+
+
+def corrcoef(x, *, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def cov(x, fweights=None, aweights=None, *, rowvar=True, ddof=True):
+    return jnp.cov(
+        x, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fweights, aweights=aweights
+    )
+
+
+def cdist(x, y, *, p=2.0):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(jnp.square(diff), axis=-1) + 1e-30)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+def tensordot(x, y, *, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def householder_product(x, tau):
+    *batch, m, n = x.shape
+
+    def one(a, t):
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[:, i]).at[i].set(1.0)
+            h = jnp.eye(m, dtype=a.dtype) - t[i] * jnp.outer(v, v.conj())
+            q = q @ h
+        return q[:, :n]
+
+    if batch:
+        flat_x = x.reshape((-1, m, n))
+        flat_t = tau.reshape((-1, tau.shape[-1]))
+        outs = jnp.stack([one(flat_x[i], flat_t[i]) for i in range(flat_x.shape[0])])
+        return outs.reshape((*batch, m, n))
+    return one(x, tau)
+
+
+def pca_lowrank(x, *, q=None, center=True, niter=2):
+    m, n = x.shape[-2], x.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    a = x - jnp.mean(x, axis=-2, keepdims=True) if center else x
+    u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+    return u[..., :q], s[..., :q], jnp.swapaxes(vh, -1, -2)[..., :q]

@@ -1,0 +1,480 @@
+"""Shape/layout manipulation op implementations.
+
+ref API: python/paddle/tensor/manipulation.py. On TPU every "view" is a
+logical XLA reshape/transpose — there is no stride machinery to preserve
+(the reference's kernels/stride/ collapses away).
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+
+builtins_slice = builtins.slice
+
+
+def reshape(x, *, shape):
+    return jnp.reshape(x, tuple(shape))
+
+
+def flatten(x, *, start_axis=0, stop_axis=-1):
+    import numpy as np
+
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape(1)
+    start = start_axis % nd
+    stop = stop_axis % nd
+    mid = int(np.prod(x.shape[start : stop + 1])) if stop >= start else 1
+    new_shape = x.shape[:start] + (mid,) + x.shape[stop + 1 :]
+    return jnp.reshape(x, new_shape)
+
+
+def squeeze(x, *, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axes = tuple(a % x.ndim for a in axis if x.shape[a % x.ndim] == 1)
+        return jnp.squeeze(x, axis=axes) if axes else x
+    a = axis % x.ndim
+    return jnp.squeeze(x, axis=a) if x.shape[a] == 1 else x
+
+
+def unsqueeze(x, *, axis):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    out = x
+    for a in sorted(int(v) if v >= 0 else int(v) + out.ndim + 1 for v in axes):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+def transpose(x, *, perm):
+    return jnp.transpose(x, tuple(perm))
+
+
+def moveaxis(x, *, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, *, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+def concat(xs, *, axis=0):
+    return jnp.concatenate(list(xs), axis=int(axis))
+
+
+def stack(xs, *, axis=0):
+    return jnp.stack(list(xs), axis=int(axis))
+
+
+def split(x, *, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    offsets = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        offsets.append(acc)
+    return tuple(jnp.split(x, offsets, axis=axis))
+
+
+def chunk(x, *, chunks, axis=0):
+    return tuple(jnp.array_split(x, chunks, axis=int(axis)))
+
+
+def tensor_split(x, *, num_or_indices, axis=0):
+    return tuple(jnp.array_split(x, num_or_indices, axis=int(axis)))
+
+
+def unbind(x, *, axis=0):
+    axis = int(axis)
+    return tuple(
+        jnp.squeeze(s, axis=axis) for s in jnp.split(x, x.shape[axis], axis=axis)
+    )
+
+
+def unstack(x, *, axis=0, num=None):
+    return unbind(x, axis=axis)
+
+
+def tile(x, *, repeat_times):
+    return jnp.tile(x, tuple(repeat_times))
+
+
+def expand(x, *, shape):
+    target = []
+    shape = list(shape)
+    ndiff = len(shape) - x.ndim
+    for i, s in enumerate(shape):
+        if s == -1:
+            target.append(x.shape[i - ndiff] if i >= ndiff else 1)
+        else:
+            target.append(int(s))
+    return jnp.broadcast_to(x, tuple(target))
+
+
+def broadcast_to(x, *, shape):
+    return expand(x, shape=shape)
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def broadcast_tensors(xs):
+    return tuple(jnp.broadcast_arrays(*xs))
+
+
+def slice(x, *, axes, starts, ends):
+    out = x
+    for ax, st, en in zip(axes, starts, ends):
+        n = out.shape[ax]
+        st = int(st)
+        en = int(en)
+        if st < 0:
+            st += n
+        if en < 0:
+            en += n
+        en = min(en, n)
+        st = max(0, min(st, n))
+        idx = [builtins_slice(None)] * out.ndim
+        idx[ax] = builtins_slice(st, en)
+        out = out[tuple(idx)]
+    return out
+
+
+def strided_slice(x, *, axes, starts, ends, strides):
+    out = x
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx = [builtins_slice(None)] * out.ndim
+        idx[ax] = builtins_slice(int(st), int(en), int(sd))
+        out = out[tuple(idx)]
+    return out
+
+
+def gather(x, index, *, axis=0):
+    idx = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, idx, axis=int(axis))
+
+
+def gather_nd(x, index):
+    # index: [..., k] indexing first k dims of x
+    k = index.shape[-1]
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def take(x, index, *, mode="raise"):
+    return jnp.take(x.reshape(-1), index.reshape(-1), mode="clip" if mode != "wrap" else "wrap").reshape(index.shape)
+
+
+def take_along_axis(x, indices, *, axis, broadcast=True):
+    if broadcast:
+        shape = list(x.shape)
+        shape[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, tuple(shape))
+    return jnp.take_along_axis(x, indices, axis=int(axis))
+
+
+def put_along_axis(x, indices, values, *, axis, reduce="assign", include_self=True, broadcast=True):
+    if broadcast:
+        shape = list(x.shape)
+        shape[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, tuple(shape))
+    values = jnp.broadcast_to(values, indices.shape).astype(x.dtype)
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=int(axis), inplace=False)
+    # build scatter indices
+    idx_grids = jnp.meshgrid(*[jnp.arange(s) for s in indices.shape], indexing="ij")
+    idx_grids[axis] = indices
+    full_idx = tuple(idx_grids)
+    at = x.at[full_idx]
+    if reduce in ("add", "sum"):
+        return at.add(values)
+    if reduce in ("mul", "multiply"):
+        return at.multiply(values)
+    if reduce == "amax":
+        return at.max(values)
+    if reduce == "amin":
+        return at.min(values)
+    if reduce == "mean":
+        ones = jnp.ones_like(values)
+        cnt = jnp.ones_like(x).at[full_idx].add(ones)
+        summed = x.at[full_idx].add(values)
+        return summed / cnt
+    raise ValueError(f"unsupported reduce: {reduce}")
+
+
+def scatter(x, index, updates, *, overwrite=True):
+    # paddle.scatter: row-wise update along axis 0 with 1-D index
+    idx = index.reshape(-1)
+    if overwrite:
+        return x.at[idx].set(updates.astype(x.dtype))
+    # paddle semantics for overwrite=False: zero the target rows then add
+    zeroed = x.at[idx].set(jnp.zeros_like(updates, dtype=x.dtype))
+    return zeroed.at[idx].add(updates.astype(x.dtype))
+
+
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates.astype(x.dtype))
+
+
+def scatter_nd(index, updates, *, shape):
+    zeros = jnp.zeros(tuple(shape), dtype=updates.dtype)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return zeros.at[idx].add(updates)
+
+
+def index_select(x, index, *, axis=0):
+    return jnp.take(x, index.reshape(-1), axis=int(axis))
+
+
+def index_sample(x, index):
+    # x: [N, C]; index: [N, K] -> out[i, j] = x[i, index[i, j]]
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_add(x, index, value, *, axis=0):
+    axis = int(axis)
+    x_moved = jnp.moveaxis(x, axis, 0)
+    v_moved = jnp.moveaxis(value, axis, 0)
+    out = x_moved.at[index.reshape(-1)].add(v_moved.astype(x.dtype))
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_put(x, indices, value, *, accumulate=False):
+    idx = tuple(indices)
+    if accumulate:
+        return x.at[idx].add(value.astype(x.dtype))
+    return x.at[idx].set(value.astype(x.dtype))
+
+
+def masked_select(x, mask):
+    # dynamic output shape: eager-only host fallback
+    import numpy as np
+
+    xv = np.asarray(x)
+    mv = np.asarray(mask)
+    return jnp.asarray(xv[np.broadcast_to(mv, xv.shape)])
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
+
+
+def masked_scatter(x, mask, value):
+    import numpy as np
+
+    xv = np.array(np.asarray(x))
+    mv = np.broadcast_to(np.asarray(mask), xv.shape)
+    vv = np.asarray(value).reshape(-1)
+    xv[mv] = vv[: int(mv.sum())]
+    return jnp.asarray(xv)
+
+
+def where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+def roll(x, *, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def flip(x, *, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def rot90(x, *, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def pad(x, *, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_last_axis=True):
+    # generic N-d constant/reflect/replicate/circular pad; `pad` is
+    # [lo, hi] * k pairs covering the LAST k dims (torch/paddle order).
+    pad = list(pad)
+    if len(pad) % 2 != 0:
+        raise ValueError("pad length must be even")
+    k = len(pad) // 2
+    width = [(0, 0)] * x.ndim
+    if pad_from_last_axis:
+        for i in range(k):
+            dim = x.ndim - 1 - i
+            width[dim] = (int(pad[2 * i]), int(pad[2 * i + 1]))
+    else:
+        for i in range(k):
+            width[i] = (int(pad[2 * i]), int(pad[2 * i + 1]))
+    jmode = {
+        "constant": "constant",
+        "reflect": "reflect",
+        "replicate": "edge",
+        "circular": "wrap",
+    }[mode]
+    if jmode == "constant":
+        return jnp.pad(x, width, mode="constant", constant_values=value)
+    return jnp.pad(x, width, mode=jmode)
+
+
+def repeat_interleave(x, *, repeats, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.repeat(x, repeats, axis=int(axis))
+
+
+def cast(x, *, dtype):
+    from ...core.dtype import to_jnp
+
+    return x.astype(to_jnp(dtype))
+
+
+def assign(x):
+    return jnp.asarray(x)
+
+
+def numel(x):
+    return jnp.asarray(x.size, dtype=jnp.int64 if False else jnp.int32)
+
+
+def diagonal(x, *, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diag(x, *, offset=0, padding_value=0.0):
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + abs(int(offset))
+        out = jnp.full((n, n), padding_value, dtype=x.dtype)
+        idx = jnp.arange(x.shape[0])
+        if offset >= 0:
+            return out.at[idx, idx + offset].set(x)
+        return out.at[idx - offset, idx].set(x)
+    return jnp.diag(x, k=int(offset))
+
+
+def diagflat(x, *, offset=0):
+    return jnp.diagflat(x, k=int(offset))
+
+
+def diag_embed(x, *, offset=0, dim1=-2, dim2=-1):
+    import numpy as np
+
+    last = x.shape[-1] + abs(int(offset))
+    batch = x.shape[:-1]
+    out = jnp.zeros(batch + (last, last), dtype=x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    if offset >= 0:
+        out = out.at[..., idx, idx + offset].set(x)
+    else:
+        out = out.at[..., idx - offset, idx].set(x)
+    # move the two new dims into requested positions
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = sorted([(d1, nd - 2), (d2, nd - 1)])
+        for pos, src in order:
+            perm.insert(pos, src)
+        out = jnp.transpose(out, perm)
+    return out
+
+
+def tril(x, *, diagonal=0):
+    return jnp.tril(x, k=int(diagonal))
+
+
+def triu(x, *, diagonal=0):
+    return jnp.triu(x, k=int(diagonal))
+
+
+def meshgrid(xs):
+    return tuple(jnp.meshgrid(*xs, indexing="ij"))
+
+
+def one_hot(x, *, num_classes):
+    import jax
+
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+def unique(x, *, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    # dynamic shape: host fallback (eager only)
+    import numpy as np
+
+    res = np.unique(
+        np.asarray(x),
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+def unique_consecutive(x, *, return_inverse=False, return_counts=False, axis=None):
+    import numpy as np
+
+    xv = np.asarray(x)
+    if axis is None:
+        xv = xv.reshape(-1)
+        keep = np.concatenate([[True], xv[1:] != xv[:-1]])
+        out = xv[keep]
+        rets = [jnp.asarray(out)]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            rets.append(jnp.asarray(inv))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, len(xv)))
+            rets.append(jnp.asarray(counts))
+        return tuple(rets) if len(rets) > 1 else rets[0]
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+def nonzero(x, *, as_tuple=False):
+    import numpy as np
+
+    res = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(r)[:, None] for r in res)
+    return jnp.asarray(np.stack(res, axis=1))
+
+
+def shard_index(x, *, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    lower = shard_id * shard_size
+    upper = lower + shard_size
+    in_shard = (x >= lower) & (x < upper)
+    return jnp.where(in_shard, x - lower, ignore_value)
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def view(x, *, shape_or_dtype):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, tuple(shape_or_dtype))
+    from ...core.dtype import to_jnp
+
+    return x.view(to_jnp(shape_or_dtype)) if hasattr(x, "view") else x.astype(to_jnp(shape_or_dtype))
+
+
+def crop(x, *, shape, offsets):
+    idx = tuple(
+        builtins_slice(int(o), int(o) + int(s)) for o, s in zip(offsets, shape)
+    )
+    return x[idx]

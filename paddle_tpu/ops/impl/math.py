@@ -1,0 +1,369 @@
+"""Elementwise math op implementations (pure jax functions).
+
+These are the TPU-native bodies behind the op contract in
+`paddle_tpu/ops/ops.yaml` — the analogue of the reference's per-device phi
+kernels (paddle/phi/kernels/cpu|gpu/*_kernel.*), except a single jnp-level
+definition lowers through XLA to every backend; VJPs come from jax.vjp so
+there is no backward.yaml counterpart to maintain.
+
+Semantics follow the reference's Python API (python/paddle/tensor/math.py),
+not numpy, wherever the two differ (e.g. `remainder` follows divisor sign,
+`scale` has bias_after_scale, `clip` accepts None bounds).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+# -- unary -----------------------------------------------------------------
+def abs(x):
+    return jnp.abs(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def logit(x, *, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def reciprocal(x):
+    return 1.0 / x
+
+
+def round(x, *, decimals=0):
+    if decimals:
+        f = 10.0**decimals
+        return jnp.round(x * f) / f
+    return jnp.round(x)
+
+
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def angle(x):
+    return jnp.angle(x)
+
+
+def conj(x):
+    return jnp.conj(x)
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)
+
+
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+def polygamma(x, *, n=1):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def sinc(x):
+    return jnp.sinc(x)
+
+
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+def nan_to_num(x, *, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+# -- binary ----------------------------------------------------------------
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.true_divide(x, y)
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def remainder(x, y):
+    # paddle.remainder == python % (sign follows divisor), i.e. jnp.mod
+    return jnp.mod(x, y)
+
+
+def fmod(x, y):
+    return jnp.fmod(x, y)
+
+
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+def ldexp(x, y):
+    return jnp.ldexp(x, y)
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+# -- scalar-parameterized --------------------------------------------------
+def scale(x, *, scale=1.0, bias=0.0, bias_after_scale=True):
+    # ref: paddle/phi/kernels/impl/scale_kernel_impl.h
+    s = jnp.asarray(scale, dtype=x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else None)
+    if bias_after_scale:
+        return x * s + bias
+    return (x + bias) * s
+
+
+def clip(x, *, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def stanh(x, *, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)  # [n, batch, ...]
+    idx = index.reshape(-1)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+def addmm(input, x, y, *, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+def logaddexp2(x, y):
+    return jnp.logaddexp2(x, y)
+
+
+def rsub(x, y):
+    return jnp.subtract(y, x)
+
+
+def square_sum(x):  # helper for norms
+    return jnp.sum(jnp.square(x))
+
+
+def trapezoid(y, x=None, *, dx=None, axis=-1):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+def diff(x, *, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def signbit(x):
+    return jnp.signbit(x)

@@ -1,0 +1,94 @@
+"""Random op implementations.
+
+Each takes an explicit `key` attr: the public wrappers in ops/api.py draw
+the key from `paddle_tpu.core.random.default_generator` OUTSIDE the traced
+body, so replay/recompute (create_graph, jit retrace) never re-samples —
+the functional analogue of the reference's Philox generator offsets
+(paddle/phi/core/generator.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dtype import default_float_dtype, to_jnp
+
+
+def _dt(dtype):
+    if dtype is None:
+        return default_float_dtype().jnp_dtype
+    return to_jnp(dtype)
+
+
+def uniform(*, key, shape, dtype=None, min=-1.0, max=1.0):
+    return jax.random.uniform(
+        key, tuple(shape), dtype=_dt(dtype), minval=min, maxval=max
+    )
+
+
+def gaussian(*, key, shape, dtype=None, mean=0.0, std=1.0):
+    return mean + std * jax.random.normal(key, tuple(shape), dtype=_dt(dtype))
+
+
+def randint(*, key, low, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(
+        key, tuple(shape), low, high, dtype=jnp.int32
+    )
+
+
+def randperm(*, key, n, dtype="int64"):
+    return jax.random.permutation(key, int(n)).astype(jnp.int32)
+
+
+def bernoulli(x, *, key):
+    return jax.random.bernoulli(key, p=x).astype(x.dtype)
+
+
+def multinomial(x, *, key, num_samples=1, replacement=False):
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if x.ndim == 1:
+        return jax.random.choice(
+            key,
+            x.shape[-1],
+            shape=(num_samples,),
+            replace=replacement,
+            p=x / jnp.sum(x),
+        ).astype(jnp.int32)
+    keys = jax.random.split(key, x.shape[0])
+    rows = [
+        jax.random.choice(
+            keys[i],
+            x.shape[-1],
+            shape=(num_samples,),
+            replace=replacement,
+            p=x[i] / jnp.sum(x[i]),
+        )
+        for i in range(x.shape[0])
+    ]
+    return jnp.stack(rows).astype(jnp.int32)
+
+
+def poisson(x, *, key):
+    return jax.random.poisson(key, x).astype(x.dtype)
+
+
+def exponential(x, *, key, lam=1.0):
+    return (jax.random.exponential(key, x.shape, dtype=x.dtype) / lam).astype(x.dtype)
+
+
+def normal_like(x, *, key, mean=0.0, std=1.0):
+    return mean + std * jax.random.normal(key, x.shape, dtype=x.dtype)
+
+
+def uniform_like(x, *, key, min=-1.0, max=1.0):
+    return jax.random.uniform(key, x.shape, dtype=x.dtype, minval=min, maxval=max)
+
+
+def shuffle(x, *, key, axis=0):
+    return jax.random.permutation(key, x, axis=axis, independent=False)
+
+
+def standard_gamma(x, *, key):
+    return jax.random.gamma(key, x).astype(x.dtype)

@@ -1,0 +1,172 @@
+"""Reduction / scan op implementations.
+
+Semantics track python/paddle/tensor/math.py + stat.py (axis=None reduces
+all dims; keepdim; paddle's std/var use unbiased=True by default).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, *, axis=None, dtype=None, keepdim=False):
+    out = jnp.sum(x, axis=_norm_axis(axis), keepdims=keepdim)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def mean(x, *, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def max(x, *, axis=None, keepdim=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def min(x, *, axis=None, keepdim=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def amax(x, *, axis=None, keepdim=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def amin(x, *, axis=None, keepdim=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def prod(x, *, axis=None, keepdim=False, dtype=None):
+    out = jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdim)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def all(x, *, axis=None, keepdim=False):
+    return jnp.all(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def any(x, *, axis=None, keepdim=False):
+    return jnp.any(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def logsumexp(x, *, axis=None, keepdim=False):
+    from jax.scipy.special import logsumexp as _lse
+
+    return _lse(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def nansum(x, *, axis=None, dtype=None, keepdim=False):
+    out = jnp.nansum(x, axis=_norm_axis(axis), keepdims=keepdim)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def nanmean(x, *, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def count_nonzero(x, *, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def std(x, *, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(
+        x, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim
+    )
+
+
+def var(x, *, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(
+        x, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim
+    )
+
+
+def median(x, *, axis=None, keepdim=False):
+    return jnp.median(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def nanmedian(x, *, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def quantile(x, q, *, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.quantile(
+        x, q, axis=_norm_axis(axis), keepdims=keepdim, method=interpolation
+    )
+
+
+def cumsum(x, *, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=int(axis))
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def cumprod(x, *, dim=None, dtype=None):
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    out = jnp.cumprod(x, axis=int(dim))
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out
+
+
+def cummax(x, *, axis=None, dtype="int64"):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    import jax.lax as lax
+
+    values = lax.associative_scan(jnp.maximum, x, axis=axis)
+    # indices: position of the running max
+    n = x.shape[axis]
+    idx = jnp.arange(n).reshape([-1 if i == (axis % x.ndim) else 1 for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+    is_new_max = x == values
+    ind = lax.associative_scan(
+        jnp.maximum, jnp.where(is_new_max, idx, -1), axis=axis
+    )
+    return values, ind.astype(jnp.dtype(dtype) if dtype != "int64" else jnp.int32)
+
+
+def cummin(x, *, axis=None, dtype="int64"):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    import jax.lax as lax
+
+    values = lax.associative_scan(jnp.minimum, x, axis=axis)
+    n = x.shape[axis]
+    idx = jnp.arange(n).reshape([-1 if i == (axis % x.ndim) else 1 for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+    is_new_min = x == values
+    ind = lax.associative_scan(
+        jnp.maximum, jnp.where(is_new_min, idx, -1), axis=axis
+    )
+    return values, ind.astype(jnp.dtype(dtype) if dtype != "int64" else jnp.int32)
+
+
+def logcumsumexp(x, *, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    import jax.lax as lax
+
+    def combine(a, b):
+        return jnp.logaddexp(a, b)
+
+    return lax.associative_scan(combine, x, axis=axis)

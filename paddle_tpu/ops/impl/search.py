@@ -1,0 +1,114 @@
+"""Search / sort op implementations (python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_IDX_DTYPE = jnp.int32  # TPU-native index dtype ('int64' requests clamp here)
+
+
+def _idx(dtype):
+    if dtype in ("int32", jnp.int32):
+        return jnp.int32
+    return _IDX_DTYPE
+
+
+def argmax(x, *, axis=None, keepdim=False, dtype="int64"):
+    if axis is None:
+        out = jnp.argmax(x.reshape(-1))
+        if keepdim:
+            out = out.reshape((1,) * x.ndim)
+        return out.astype(_idx(dtype))
+    out = jnp.argmax(x, axis=int(axis), keepdims=keepdim)
+    return out.astype(_idx(dtype))
+
+
+def argmin(x, *, axis=None, keepdim=False, dtype="int64"):
+    if axis is None:
+        out = jnp.argmin(x.reshape(-1))
+        if keepdim:
+            out = out.reshape((1,) * x.ndim)
+        return out.astype(_idx(dtype))
+    out = jnp.argmin(x, axis=int(axis), keepdims=keepdim)
+    return out.astype(_idx(dtype))
+
+
+def argsort(x, *, axis=-1, descending=False, stable=False):
+    out = jnp.argsort(x, axis=int(axis), stable=stable or not descending, descending=descending)
+    return out.astype(_IDX_DTYPE)
+
+
+def sort(x, *, axis=-1, descending=False, stable=False):
+    return jnp.sort(x, axis=int(axis), descending=descending)
+
+
+def topk(x, *, k, axis=-1, largest=True, sorted=True):
+    import jax
+
+    axis = int(axis) % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idxs = jax.lax.top_k(moved, k)
+    else:
+        vals, idxs = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return (
+        jnp.moveaxis(vals, -1, axis),
+        jnp.moveaxis(idxs, -1, axis).astype(_IDX_DTYPE),
+    )
+
+
+def kthvalue(x, *, k, axis=-1, keepdim=False):
+    axis = int(axis) % x.ndim
+    sorted_vals = jnp.sort(x, axis=axis)
+    sorted_idx = jnp.argsort(x, axis=axis, stable=True)
+    vals = jnp.take(sorted_vals, k - 1, axis=axis)
+    idxs = jnp.take(sorted_idx, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idxs = jnp.expand_dims(idxs, axis)
+    return vals, idxs.astype(_IDX_DTYPE)
+
+
+def mode(x, *, axis=-1, keepdim=False):
+    import jax
+
+    axis = int(axis) % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    sorted_v = jnp.sort(moved, axis=-1)
+    n = sorted_v.shape[-1]
+    # run-length: count of each element = number of equal elements
+    eq = sorted_v[..., :, None] == sorted_v[..., None, :]
+    counts = jnp.sum(eq, axis=-1)
+    best = jnp.argmax(counts, axis=-1)
+    vals = jnp.take_along_axis(sorted_v, best[..., None], axis=-1)[..., 0]
+    idx = jnp.argmax(
+        (moved == vals[..., None])
+        * (jnp.arange(n) + 1),
+        axis=-1,
+    )
+    if keepdim:
+        vals = vals[..., None]
+        idx = idx[..., None]
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(_IDX_DTYPE)
+
+
+def searchsorted(sorted_sequence, values, *, out_int32=False, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        import jax
+
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]),
+        ).reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else _IDX_DTYPE)
+
+
+def bucketize(x, sorted_sequence, *, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else _IDX_DTYPE)

@@ -1,0 +1,101 @@
+"""Patch operator methods onto Tensor.
+
+Analogue of the reference's tensor_patch_methods.py +
+eager_math_op_patch.cc: the generated TENSOR_METHOD_TABLE supplies named
+methods; this module adds the dunder protocol, indexing, and properties.
+Called once from paddle_tpu/__init__.py.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from . import api
+
+
+def _binary(op_name, swap=False):
+    fn = getattr(api, op_name)
+
+    if swap:
+
+        def method(self, other):
+            return fn(other if isinstance(other, Tensor) else Tensor(other), self)
+
+    else:
+
+        def method(self, other):
+            return fn(self, other)
+
+    return method
+
+
+def patch():
+    for method_name, op_name in api.TENSOR_METHOD_TABLE:
+        if not hasattr(Tensor, method_name):
+            setattr(Tensor, method_name, getattr(api, op_name))
+
+    dunders = {
+        "__add__": _binary("add"),
+        "__radd__": _binary("add", swap=True),
+        "__sub__": _binary("subtract"),
+        "__rsub__": _binary("subtract", swap=True),
+        "__mul__": _binary("multiply"),
+        "__rmul__": _binary("multiply", swap=True),
+        "__truediv__": _binary("divide"),
+        "__rtruediv__": _binary("divide", swap=True),
+        "__floordiv__": _binary("floor_divide"),
+        "__rfloordiv__": _binary("floor_divide", swap=True),
+        "__mod__": _binary("remainder"),
+        "__rmod__": _binary("remainder", swap=True),
+        "__pow__": _binary("pow"),
+        "__rpow__": _binary("pow", swap=True),
+        "__matmul__": _binary("matmul"),
+        "__rmatmul__": _binary("matmul", swap=True),
+        "__lt__": _binary("less_than"),
+        "__le__": _binary("less_equal"),
+        "__gt__": _binary("greater_than"),
+        "__ge__": _binary("greater_equal"),
+        "__eq__": _binary("equal"),
+        "__ne__": _binary("not_equal"),
+        "__and__": _binary("bitwise_and"),
+        "__or__": _binary("bitwise_or"),
+        "__xor__": _binary("bitwise_xor"),
+        "__lshift__": _binary("bitwise_left_shift"),
+        "__rshift__": _binary("bitwise_right_shift"),
+    }
+    for name, m in dunders.items():
+        setattr(Tensor, name, m)
+
+    Tensor.__neg__ = lambda self: api.neg(self)
+    Tensor.__abs__ = lambda self: api.abs(self)
+    Tensor.__invert__ = lambda self: (
+        api.logical_not(self) if self.dtype.is_bool else api.bitwise_not(self)
+    )
+    Tensor.__getitem__ = lambda self, item: api.getitem(self, item)
+    Tensor.__setitem__ = lambda self, item, value: api.setitem(self, item, value)
+
+    def _iter(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    Tensor.__iter__ = _iter
+    # NumPy must not hijack `ndarray <op> Tensor` — force our reflected ops.
+    Tensor.__array_priority__ = 100.0
+    Tensor.__hash__ = lambda self: id(self)
+
+    def _T(self):
+        if self.ndim < 2:
+            return self
+        return api.transpose(self, list(range(self.ndim))[::-1])
+
+    Tensor.T = property(_T)
+    Tensor.mT = property(lambda self: api.t(self))
+    Tensor.pow = lambda self, y: api.pow(self, y)
+    Tensor.norm = lambda self, p=None, axis=None, keepdim=False: api.norm(
+        self, p, axis, keepdim
+    )
+    Tensor.dim = lambda self: self.ndim
+    Tensor.ndimension = lambda self: self.ndim
+    Tensor.rank = lambda self: Tensor(self.ndim)
+    Tensor.element_size = lambda self: self.dtype.itemsize
+    Tensor.flatten = lambda self, start_axis=0, stop_axis=-1: api.flatten(
+        self, start_axis, stop_axis
+    )

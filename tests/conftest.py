@@ -1,0 +1,25 @@
+"""Test configuration: force a virtual 8-device CPU mesh.
+
+Mirrors the reference's test strategy of simulating multi-node on one host
+(SURVEY §4: CommunicationTestDistBase launches --nnode=N against 127.0.0.1);
+on TPU the analogue is XLA's forced host-platform device count, giving every
+distributed test an 8-device mesh without hardware.
+"""
+import os
+
+# Must OVERRIDE (not setdefault): the sandbox exports JAX_PLATFORMS=axon to
+# route to the real TPU chip; unit tests want the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_cfg_done = False
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The axon PJRT plugin (sitecustomize) registers itself as the priority
+# backend regardless of JAX_PLATFORMS env — the config knob is authoritative.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

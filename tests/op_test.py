@@ -1,0 +1,111 @@
+"""OpTest harness.
+
+Re-creation of the reference's op unit-test pattern
+(test/legacy_test/op_test.py:418): run an op eagerly, compare against a
+numpy reference, and check analytic gradients against numeric central
+differences (get_numeric_gradient, op_test.py:148), across dtypes with
+per-dtype tolerances.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+# fp32 rtol accommodates XLA's fast transcendental approximations (~1e-4
+# rel vs numpy); the reference uses comparable per-op white-lists
+# (test/white_list/op_accuracy_white_list.py).
+DEFAULT_TOL = {"float32": 5e-4, "float64": 1e-12, "bfloat16": 2e-2, "float16": 1e-2}
+GRAD_TOL = {"float32": 5e-3, "float64": 1e-7, "bfloat16": 5e-2, "float16": 2e-2}
+
+
+def check_output(op_fn, np_fn, inputs, attrs=None, rtol=None, atol=None, dtype="float32"):
+    """inputs: dict name->np.ndarray. op_fn(**tensors, **attrs) vs np_fn(**inputs, **attrs)."""
+    attrs = attrs or {}
+    tensors = {k: paddle.to_tensor(v) for k, v in inputs.items()}
+    got = op_fn(**tensors, **attrs)
+    want = np_fn(**{k: v.copy() for k, v in inputs.items()}, **attrs)
+    tol = rtol if rtol is not None else DEFAULT_TOL.get(dtype, 1e-5)
+    _assert_tree_close(got, want, rtol=tol, atol=atol if atol is not None else tol)
+
+
+def _assert_tree_close(got, want, rtol, atol):
+    if isinstance(want, (tuple, list)):
+        assert isinstance(got, (tuple, list)) and len(got) == len(want)
+        for g, w in zip(got, want):
+            _assert_tree_close(g, w, rtol, atol)
+        return
+    g = got.numpy() if hasattr(got, "numpy") else np.asarray(got)
+    np.testing.assert_allclose(
+        np.asarray(g, dtype=np.float64) if g.dtype.kind == "f" else g,
+        np.asarray(want, dtype=np.float64) if np.asarray(want).dtype.kind == "f" else want,
+        rtol=rtol,
+        atol=atol,
+    )
+
+
+def numeric_gradient(op_fn, inputs, attrs, wrt, delta=1e-2, output_index=None):
+    """Central-difference gradient of sum(op(inputs)) wrt inputs[wrt]."""
+    attrs = attrs or {}
+
+    def run(vals):
+        tensors = {k: paddle.to_tensor(v) for k, v in vals.items()}
+        out = op_fn(**tensors, **attrs)
+        if isinstance(out, (tuple, list)):
+            out = out[output_index or 0]
+        return float(out.sum().numpy())
+
+    base = {k: np.asarray(v, dtype=np.float64) for k, v in inputs.items()}
+    x = base[wrt]
+    grad = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        orig = x[idx]
+        x[idx] = orig + delta
+        plus = run(base)
+        x[idx] = orig - delta
+        minus = run(base)
+        x[idx] = orig
+        grad[idx] = (plus - minus) / (2 * delta)
+        it.iternext()
+    return grad
+
+
+def check_grad(
+    op_fn,
+    inputs,
+    attrs=None,
+    wrt=None,
+    delta=1e-2,
+    rtol=None,
+    dtype="float32",
+    output_index=None,
+):
+    """Compare tape gradients against numeric central differences."""
+    attrs = attrs or {}
+    wrt = wrt or list(inputs.keys())
+    if isinstance(wrt, str):
+        wrt = [wrt]
+    tensors = {
+        k: paddle.to_tensor(np.asarray(v), stop_gradient=k not in wrt)
+        for k, v in inputs.items()
+    }
+    out = op_fn(**tensors, **attrs)
+    if isinstance(out, (tuple, list)):
+        out = out[output_index or 0]
+    out.sum().backward()
+    tol = rtol if rtol is not None else GRAD_TOL.get(dtype, 5e-3)
+    for k in wrt:
+        analytic = tensors[k].grad
+        assert analytic is not None, f"no grad for input {k}"
+        numeric = numeric_gradient(
+            op_fn, inputs, attrs, k, delta=delta, output_index=output_index
+        )
+        np.testing.assert_allclose(
+            np.asarray(analytic.numpy(), dtype=np.float64),
+            numeric,
+            rtol=tol,
+            atol=tol,
+            err_msg=f"gradient mismatch for input {k}",
+        )

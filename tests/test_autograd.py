@@ -1,0 +1,175 @@
+"""Autograd engine tests (backward engine, paddle.grad, hooks, PyLayer).
+
+Mirrors the reference's eager autograd semantics (fluid/eager/backward.cc,
+python/paddle/autograd)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_backward_accumulates_into_leaves():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
+    # second backward accumulates
+    y2 = (3.0 * x).sum()
+    y2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 7.0])
+
+
+def test_backward_shared_subexpression():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    a = x * x  # used twice
+    y = a + a
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)
+
+
+def test_backward_diamond_graph():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    a = x * 3.0
+    b = x * 4.0
+    y = a * b  # dy/dx = 2 * 12 * x = 48... y=12x^2, dy/dx=24x=48
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 48.0)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    assert y.grad is None
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_detach_cuts_graph():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = (x + y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+
+def test_no_grad_context_and_decorator():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+    @paddle.no_grad()
+    def f(t):
+        return t * 3
+
+    assert f(x).stop_gradient
+
+
+def test_grad_api_basic_and_unused():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = paddle.to_tensor(3.0, stop_gradient=False)
+    z = x * x
+    (gx,) = paddle.grad(z, x)
+    np.testing.assert_allclose(gx.numpy(), 4.0)
+    assert x.grad is None  # paddle.grad must not write .grad
+    with pytest.raises(RuntimeError):
+        paddle.grad(z, y)
+    gx, gy = paddle.grad(z, [x, y], allow_unused=True)
+    assert gy is None
+
+
+def test_grad_create_graph_second_order():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x
+    (g,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(g.numpy(), 12.0)
+    (g2,) = paddle.grad(g, x, create_graph=True)
+    np.testing.assert_allclose(g2.numpy(), 12.0)
+    (g3,) = paddle.grad(g2, x)
+    np.testing.assert_allclose(g3.numpy(), 6.0)
+
+
+def test_non_scalar_backward_requires_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(paddle.to_tensor([1.0, 0.5]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 8.0)
+
+
+def test_register_hook_scales_grad():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    h = x.register_hook(lambda g: g * 10)
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0, 20.0])
+    h.remove()
+    x.clear_grad()
+    (x * 2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_multi_output_op_partial_use():
+    x = paddle.to_tensor(np.array([3.0, 1.0, 2.0], np.float32), stop_gradient=False)
+    values, indices = paddle.topk(x, k=2)
+    values.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
+
+
+def test_int_output_not_differentiable():
+    x = paddle.to_tensor([1.0, 5.0, 3.0], stop_gradient=False)
+    idx = paddle.argmax(x)
+    assert idx.stop_gradient
+
+
+def test_pylayer_custom_backward():
+    class Cube(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor
+            return grad * 3 * x * x
+
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = Cube.apply(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)
+
+
+def test_jacobian_hessian():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+    jac = paddle.autograd.jacobian(lambda t: (t * t).sum(), x)
+    np.testing.assert_allclose(jac.numpy(), [2.0, 4.0])
+    hes = paddle.autograd.hessian(lambda t: (t * t).sum(), x)
+    np.testing.assert_allclose(hes.numpy(), [[2.0, 0.0], [0.0, 2.0]])
+
+
+def test_inplace_on_tracked_leaf_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with pytest.raises(RuntimeError):
+        x.add_(paddle.to_tensor([1.0]))
+    with paddle.no_grad():
+        x.add_(paddle.to_tensor([1.0]))  # optimizer-style update is fine
+    np.testing.assert_allclose(x.numpy(), [2.0])
+
+
+def test_inplace_on_intermediate_tracks_grad():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 3
+    y.add_(paddle.to_tensor([1.0]))
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0])

@@ -1,0 +1,137 @@
+"""Op correctness + numeric-gradient tests (OpTest pattern, SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+rng = np.random.default_rng(7)
+
+
+UNARY_CASES = [
+    ("abs", np.abs, rng.standard_normal((3, 4)).astype("float32") + 0.5),
+    ("exp", np.exp, rng.standard_normal((3, 4)).astype("float32")),
+    ("log", np.log, rng.uniform(0.5, 2.0, (3, 4)).astype("float32")),
+    ("sqrt", np.sqrt, rng.uniform(0.5, 2.0, (3, 4)).astype("float32")),
+    ("tanh", np.tanh, rng.standard_normal((3, 4)).astype("float32")),
+    ("sin", np.sin, rng.standard_normal((3, 4)).astype("float32")),
+    ("cos", np.cos, rng.standard_normal((3, 4)).astype("float32")),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), rng.standard_normal((3, 4)).astype("float32")),
+    ("floor", np.floor, rng.standard_normal((3, 4)).astype("float32")),
+    ("square", np.square, rng.standard_normal((3, 4)).astype("float32")),
+    ("rsqrt", lambda x: 1 / np.sqrt(x), rng.uniform(0.5, 2.0, (3, 4)).astype("float32")),
+    ("erf", None, rng.standard_normal((3, 4)).astype("float32")),
+]
+
+
+@pytest.mark.parametrize("name,np_fn,x", UNARY_CASES, ids=[c[0] for c in UNARY_CASES])
+def test_unary_output(name, np_fn, x):
+    op = getattr(paddle, name)
+    if np_fn is None:
+        import scipy.special  # noqa: F401  — skip if unavailable
+
+        pytest.importorskip("scipy")
+        np_fn = {"erf": __import__("scipy.special", fromlist=["erf"]).erf}[name]
+    check_output(op, lambda x: np_fn(x), {"x": x})
+
+
+DIFF_UNARY = ["exp", "log", "sqrt", "tanh", "sin", "cos", "sigmoid", "square"]
+
+
+@pytest.mark.parametrize("name", DIFF_UNARY)
+def test_unary_grad(name):
+    x = rng.uniform(0.5, 1.5, (2, 3)).astype("float32")
+    check_grad(getattr(paddle, name), {"x": x})
+
+
+BINARY_CASES = [
+    ("add", np.add),
+    ("subtract", np.subtract),
+    ("multiply", np.multiply),
+    ("divide", np.divide),
+    ("maximum", np.maximum),
+    ("minimum", np.minimum),
+    ("atan2", np.arctan2),
+]
+
+
+@pytest.mark.parametrize("name,np_fn", BINARY_CASES, ids=[c[0] for c in BINARY_CASES])
+def test_binary_output(name, np_fn):
+    x = rng.uniform(0.5, 1.5, (3, 4)).astype("float32")
+    y = rng.uniform(0.5, 1.5, (3, 4)).astype("float32")
+    check_output(getattr(paddle, name), lambda x, y: np_fn(x, y), {"x": x, "y": y})
+
+
+@pytest.mark.parametrize("name", ["add", "subtract", "multiply", "divide"])
+def test_binary_grad(name):
+    x = rng.uniform(0.5, 1.5, (2, 3)).astype("float32")
+    y = rng.uniform(0.5, 1.5, (2, 3)).astype("float32")
+    check_grad(getattr(paddle, name), {"x": x, "y": y})
+
+
+def test_binary_broadcast_grad():
+    x = rng.uniform(0.5, 1.5, (2, 3)).astype("float32")
+    y = rng.uniform(0.5, 1.5, (3,)).astype("float32")
+    check_grad(paddle.add, {"x": x, "y": y})
+    check_grad(paddle.multiply, {"x": x, "y": y})
+
+
+def test_matmul_output_and_grad():
+    x = rng.standard_normal((4, 5)).astype("float32")
+    y = rng.standard_normal((5, 3)).astype("float32")
+    check_output(paddle.matmul, lambda x, y: x @ y, {"x": x, "y": y})
+    check_grad(paddle.matmul, {"x": x, "y": y})
+    # transpose flags
+    check_output(
+        paddle.matmul,
+        lambda x, y, transpose_y: x @ y.T,
+        {"x": x, "y": rng.standard_normal((3, 5)).astype("float32")},
+        attrs={"transpose_y": True},
+    )
+
+
+def test_reductions():
+    x = rng.standard_normal((3, 4, 5)).astype("float32")
+    check_output(paddle.sum, lambda x: np.sum(x), {"x": x})
+    check_output(paddle.sum, lambda x, axis, keepdim: np.sum(x, axis=tuple(axis), keepdims=keepdim),
+                 {"x": x}, attrs={"axis": [1, 2], "keepdim": True})
+    check_output(paddle.mean, lambda x, axis: np.mean(x, axis=axis), {"x": x}, attrs={"axis": 1})
+    check_output(paddle.max, lambda x, axis: np.max(x, axis=axis), {"x": x}, attrs={"axis": 0})
+    check_output(paddle.prod, lambda x, axis: np.prod(x, axis=axis), {"x": x}, attrs={"axis": 2})
+    check_output(paddle.std, lambda x: np.std(x, ddof=1), {"x": x})
+    check_output(paddle.var, lambda x: np.var(x, ddof=1), {"x": x})
+    check_output(paddle.logsumexp, lambda x: np.log(np.sum(np.exp(x))), {"x": x})
+    check_grad(paddle.sum, {"x": x})
+    check_grad(paddle.mean, {"x": x}, attrs={"axis": 1})
+    check_grad(paddle.logsumexp, {"x": x[:2, :2, 0]})
+
+
+def test_cumsum_cumprod():
+    x = rng.uniform(0.5, 1.5, (3, 4)).astype("float32")
+    check_output(paddle.cumsum, lambda x, axis: np.cumsum(x, axis=axis), {"x": x}, attrs={"axis": 1})
+    check_output(paddle.cumsum, lambda x: np.cumsum(x), {"x": x})
+    check_output(paddle.cumprod, lambda x, dim: np.cumprod(x, axis=dim), {"x": x}, attrs={"dim": 0})
+    check_grad(paddle.cumsum, {"x": x}, attrs={"axis": 1})
+
+
+def test_scale_clip():
+    x = rng.standard_normal((3, 4)).astype("float32")
+    check_output(
+        paddle.scale,
+        lambda x, scale, bias: x * scale + bias,
+        {"x": x},
+        attrs={"scale": 2.0, "bias": 1.0},
+    )
+    check_output(
+        paddle.clip, lambda x, min, max: np.clip(x, min, max), {"x": x}, attrs={"min": -0.5, "max": 0.5}
+    )
+    check_grad(paddle.scale, {"x": x}, attrs={"scale": 3.0})
+
+
+def test_pow_remainder():
+    x = rng.uniform(0.5, 2.0, (3,)).astype("float32")
+    check_output(paddle.pow, lambda x, y: x ** y, {"x": x, "y": np.float32(2.0)})
+    a = np.array([-3, -2, 5, 7], dtype=np.int32)
+    b = np.array([2, 3, 3, 4], dtype=np.int32)
+    got = paddle.remainder(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+    np.testing.assert_array_equal(got, a % b)

@@ -1,0 +1,189 @@
+"""Tensor API surface tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_dtypes():
+    assert paddle.to_tensor([1, 2]).dtype == paddle.int32
+    assert paddle.to_tensor([1.0]).dtype == paddle.float32
+    assert paddle.to_tensor(True).dtype.name == "bool"
+    t = paddle.to_tensor([1.0], dtype="bfloat16")
+    assert t.dtype == paddle.bfloat16
+    assert paddle.to_tensor(np.zeros((2, 2), np.float64)).dtype == paddle.float64
+
+
+def test_shape_and_metadata():
+    x = paddle.zeros([2, 3, 4])
+    assert x.shape == [2, 3, 4]
+    assert x.ndim == 3
+    assert x.size == 24
+    assert len(x) == 2
+    assert x.numel().item() == 24
+
+
+def test_numpy_roundtrip_and_item():
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    t = paddle.to_tensor(a)
+    np.testing.assert_array_equal(t.numpy(), a)
+    assert paddle.to_tensor(3.5).item() == pytest.approx(3.5)
+
+
+def test_astype_cast():
+    x = paddle.ones([2]).astype("int32")
+    assert x.dtype == paddle.int32
+    y = x.cast("float32")
+    assert y.dtype == paddle.float32
+
+
+def test_dunder_arithmetic():
+    x = paddle.to_tensor([1.0, 2.0])
+    np.testing.assert_allclose((x + 1).numpy(), [2.0, 3.0])
+    np.testing.assert_allclose((1 + x).numpy(), [2.0, 3.0])
+    np.testing.assert_allclose((x * x).numpy(), [1.0, 4.0])
+    np.testing.assert_allclose((2 / x).numpy(), [2.0, 1.0])
+    np.testing.assert_allclose((x - 3).numpy(), [-2.0, -1.0])
+    np.testing.assert_allclose((-x).numpy(), [-1.0, -2.0])
+    np.testing.assert_allclose((x ** 2).numpy(), [1.0, 4.0])
+    np.testing.assert_allclose(abs(paddle.to_tensor([-1.0])).numpy(), [1.0])
+    assert bool((x[0] < x[1]).item())
+
+
+def test_comparison_returns_tensor():
+    x = paddle.to_tensor([1.0, 2.0])
+    y = paddle.to_tensor([2.0, 2.0])
+    eq = x == y
+    assert eq.dtype.name == "bool"
+    np.testing.assert_array_equal(eq.numpy(), [False, True])
+
+
+def test_indexing_basic_and_advanced():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_array_equal(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_array_equal(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_array_equal(x[::2, ::2].numpy(), [[0, 2], [8, 10]])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_array_equal(x[idx].numpy(), [[0, 1, 2, 3], [8, 9, 10, 11]])
+    np.testing.assert_array_equal(x[x > 5].numpy().shape, (6,))
+
+
+def test_indexing_grad_flows():
+    x = paddle.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+    y = x[1:, :2].sum()
+    y.backward()
+    expected = np.zeros((3, 4), np.float32)
+    expected[1:, :2] = 1.0
+    np.testing.assert_array_equal(x.grad.numpy(), expected)
+
+
+def test_setitem():
+    x = paddle.zeros([3, 3])
+    x[1, 1] = 7.0
+    assert x[1, 1].item() == 7.0
+    x[0] = paddle.ones([3])
+    np.testing.assert_array_equal(x[0].numpy(), [1, 1, 1])
+
+
+def test_T_property_and_transpose():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert x.T.shape == [3, 2]
+    assert paddle.transpose(x, [1, 0]).shape == [3, 2]
+
+
+def test_clone_detach_semantics():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x.clone()
+    assert not y.stop_gradient  # clone stays on the graph
+    z = x.detach()
+    assert z.stop_gradient
+
+
+def test_inplace_version_bump():
+    x = paddle.zeros([2])
+    v0 = x.inplace_version
+    with paddle.no_grad():
+        x.add_(paddle.ones([2]))
+    assert x.inplace_version == v0 + 1
+
+
+def test_manipulation_ops():
+    x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert paddle.reshape(x, [6, 4]).shape == [6, 4]
+    assert paddle.flatten(x, 1, 2).shape == [2, 12]
+    assert paddle.unsqueeze(x, [0, -1]).shape == [1, 2, 3, 4, 1]
+    assert paddle.squeeze(paddle.ones([1, 2, 1]), None).shape == [2]
+    parts = paddle.split(x, [1, 2], axis=1)
+    assert [p.shape for p in parts] == [[2, 1, 4], [2, 2, 4]]
+    c = paddle.concat([x, x], axis=0)
+    assert c.shape == [4, 3, 4]
+    s = paddle.stack([x, x], axis=0)
+    assert s.shape == [2, 2, 3, 4]
+    assert paddle.tile(paddle.ones([2]), [3]).shape == [6]
+    assert paddle.expand(paddle.ones([1, 3]), [5, 3]).shape == [5, 3]
+    assert paddle.expand(paddle.ones([1, 3]), [5, -1]).shape == [5, 3]
+
+
+def test_gather_scatter():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_array_equal(
+        paddle.gather(x, idx, 0).numpy(), x.numpy()[[0, 2]]
+    )
+    upd = paddle.ones([2, 3])
+    out = paddle.scatter(x, idx, upd)
+    ref = x.numpy().copy()
+    ref[[0, 2]] = 1.0
+    np.testing.assert_array_equal(out.numpy(), ref)
+
+
+def test_where_and_masked_fill():
+    x = paddle.to_tensor([1.0, -1.0, 2.0])
+    out = paddle.where(x > 0, x, paddle.zeros_like(x))
+    np.testing.assert_array_equal(out.numpy(), [1.0, 0.0, 2.0])
+    mf = paddle.masked_fill(x, x < 0, 9.0)
+    np.testing.assert_array_equal(mf.numpy(), [1.0, 9.0, 2.0])
+
+
+def test_creation_ops():
+    assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+    assert paddle.arange(1, 10, 3).numpy().tolist() == [1, 4, 7]
+    np.testing.assert_array_equal(paddle.eye(2).numpy(), np.eye(2, dtype=np.float32))
+    assert paddle.full([2], 7).numpy().tolist() == [7, 7]
+    assert paddle.linspace(0, 1, 5).shape == [5]
+    x = paddle.ones([2, 2])
+    assert paddle.zeros_like(x).numpy().sum() == 0
+
+
+def test_random_reproducible():
+    paddle.seed(42)
+    a = paddle.rand([4])
+    paddle.seed(42)
+    b = paddle.rand([4])
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+    assert 0.0 <= a.numpy().min() and a.numpy().max() < 1.0
+
+
+def test_sort_search():
+    x = paddle.to_tensor([3.0, 1.0, 2.0])
+    np.testing.assert_array_equal(paddle.sort(x).numpy(), [1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(paddle.argsort(x).numpy(), [1, 2, 0])
+    v, i = paddle.topk(x, k=2)
+    np.testing.assert_array_equal(v.numpy(), [3.0, 2.0])
+    np.testing.assert_array_equal(i.numpy(), [0, 2])
+    assert paddle.argmax(x).item() == 0
+
+
+def test_einsum():
+    a = np.random.rand(2, 3).astype(np.float32)
+    b = np.random.rand(3, 4).astype(np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_nonzero_unique_host_fallback():
+    x = paddle.to_tensor([0.0, 1.0, 0.0, 2.0])
+    nz = paddle.nonzero(x)
+    np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+    u = paddle.unique(paddle.to_tensor([3, 1, 3, 2]))
+    np.testing.assert_array_equal(np.sort(u.numpy()), [1, 2, 3])
