@@ -42,9 +42,11 @@ def squeeze(x, *, axis=None):
 
 
 def unsqueeze(x, *, axis):
-    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = list(axis) if isinstance(axis, (list, tuple)) else [axis]
+    out_ndim = x.ndim + len(axes)
+    norm = sorted(int(a) if a >= 0 else int(a) + out_ndim for a in axes)
     out = x
-    for a in sorted(int(v) if v >= 0 else int(v) + out.ndim + 1 for v in axes):
+    for a in norm:
         out = jnp.expand_dims(out, a)
     return out
 
