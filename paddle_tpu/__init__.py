@@ -51,7 +51,10 @@ from .autograd import grad  # noqa: E402  (needs patched Tensor)
 from . import amp  # noqa: E402
 from . import autograd  # noqa: E402
 from . import framework  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import regularizer  # noqa: E402
 from .framework.io_api import load, save  # noqa: E402
+from .nn.parameter import ParamAttr  # noqa: E402
 
 # `bool` dtype under its paddle name (shadows builtin only inside namespace)
 bool = bool_

@@ -1,0 +1,115 @@
+"""Gradient clipping strategies.
+
+API of the reference's ``paddle.nn.ClipGradBy*`` (ref: python/paddle/nn/clip.py:
+ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm). TPU-first shape: each
+strategy exposes ``_clip_arrays(params, grads, need_clip) -> grads`` — a pure
+jnp function over raw arrays — so the optimizer can stage clipping into the
+same XLA program as the update (the reference runs clip as eager ops between
+backward and step). The Tensor-level ``__call__`` keeps the reference's
+params_grads API for eager use.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        """Eager path: list of (param, grad) Tensors -> same with clipped
+        grads (ref clip.py _dygraph_clip)."""
+        params = [p._data for p, _ in params_grads]
+        grads = [
+            g._data if isinstance(g, Tensor) else g for _, g in params_grads
+        ]
+        need = [
+            getattr(p, "need_clip", True) and g is not None
+            for (p, _), g in zip(params_grads, grads)
+        ]
+        clipped = self._clip_arrays(params, grads, need)
+        out = []
+        for (p, g), c in zip(params_grads, clipped):
+            if g is None or c is None:
+                out.append((p, g))
+            else:
+                out.append((p, Tensor(c, stop_gradient=True)))
+        return out
+
+    def _clip_arrays(self, params, grads, need_clip):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    """Elementwise clip to [min, max] (ref: nn/clip.py ClipGradByValue)."""
+
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __str__(self):
+        return f"Clip Gradient By Value, min = {self.min}, max={self.max}"
+
+    def _clip_arrays(self, params, grads, need_clip):
+        return [
+            jnp.clip(g, self.min, self.max) if (g is not None and n) else g
+            for g, n in zip(grads, need_clip)
+        ]
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Per-tensor L2-norm clip (ref: nn/clip.py ClipGradByNorm)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __str__(self):
+        return f"Gradient Clip By Norm, clip_norm={self.clip_norm}"
+
+    def _clip_arrays(self, params, grads, need_clip):
+        out = []
+        for g, n in zip(grads, need_clip):
+            if g is None or not n:
+                out.append(g)
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip across the whole grad set
+    (ref: nn/clip.py ClipGradByGlobalNorm). Norm is accumulated in fp32
+    regardless of grad dtype (bf16-safe on TPU)."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+        self.auto_skip_clip = auto_skip_clip
+
+    def __str__(self):
+        return f"Gradient Clip By GlobalNorm, global_norm={self.clip_norm}"
+
+    def _clip_arrays(self, params, grads, need_clip):
+        sq = [
+            jnp.sum(jnp.square(g.astype(jnp.float32)))
+            for g, n in zip(grads, need_clip)
+            if g is not None and n
+        ]
+        if not sq:
+            return grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for g, n in zip(grads, need_clip):
+            if g is None or not n:
+                out.append(g)
+            else:
+                out.append(
+                    (g.astype(jnp.float32) * scale).astype(g.dtype)
+                )
+        return out

@@ -1,0 +1,34 @@
+"""paddle.optimizer analogue (ref: python/paddle/optimizer/__init__.py)."""
+from . import lr
+from .adadelta import Adadelta
+from .adagrad import Adagrad
+from .adam import Adam
+from .adamax import Adamax
+from .adamw import AdamW
+from .asgd import ASGD
+from .lamb import Lamb
+from .momentum import Momentum
+from .nadam import NAdam
+from .optimizer import Optimizer
+from .radam import RAdam
+from .rmsprop import RMSProp
+from .rprop import Rprop
+from .sgd import SGD
+
+__all__ = [
+    "Optimizer",
+    "SGD",
+    "Momentum",
+    "Adagrad",
+    "Adadelta",
+    "Adam",
+    "AdamW",
+    "Adamax",
+    "ASGD",
+    "Lamb",
+    "NAdam",
+    "RAdam",
+    "RMSProp",
+    "Rprop",
+    "lr",
+]

@@ -1,0 +1,44 @@
+"""Adadelta (ref: python/paddle/optimizer/adadelta.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class Adadelta(Optimizer):
+    _acc_names = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, multi_precision=False):
+        super().__init__(
+            learning_rate=learning_rate,
+            parameters=parameters,
+            weight_decay=weight_decay,
+            grad_clip=grad_clip,
+            name=name,
+            multi_precision=multi_precision,
+        )
+        self._epsilon = float(epsilon)
+        self._rho = float(rho)
+
+    def _init_state(self, p):
+        return {
+            "avg_squared_grad": jnp.zeros_like(p),
+            "avg_squared_update": jnp.zeros_like(p),
+        }
+
+    def _update(self, p, g, state, lr, t, attr):
+        rho, eps = self._rho, self._epsilon
+        avg_g = rho * state["avg_squared_grad"] + (1 - rho) * jnp.square(g)
+        delta = (
+            jnp.sqrt(state["avg_squared_update"] + eps)
+            / jnp.sqrt(avg_g + eps)
+            * g
+        )
+        avg_u = rho * state["avg_squared_update"] + (1 - rho) * jnp.square(delta)
+        return p - lr * delta, {
+            "avg_squared_grad": avg_g,
+            "avg_squared_update": avg_u,
+        }

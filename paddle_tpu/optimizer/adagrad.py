@@ -1,0 +1,32 @@
+"""Adagrad (ref: python/paddle/optimizer/adagrad.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class Adagrad(Optimizer):
+    _acc_names = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0, multi_precision=False):
+        super().__init__(
+            learning_rate=learning_rate,
+            parameters=parameters,
+            weight_decay=weight_decay,
+            grad_clip=grad_clip,
+            name=name,
+            multi_precision=multi_precision,
+        )
+        self._epsilon = float(epsilon)
+        self._initial = float(initial_accumulator_value)
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p, self._initial)}
+
+    def _update(self, p, g, state, lr, t, attr):
+        moment = state["moment"] + jnp.square(g)
+        new_p = p - lr * g / (jnp.sqrt(moment) + self._epsilon)
+        return new_p, {"moment": moment}

@@ -1,0 +1,63 @@
+"""Adam (ref: python/paddle/optimizer/adam.py; kernel math
+phi/kernels/funcs/adam_functors.h). Bias correction is computed from the
+global step scalar instead of per-param beta-pow accumulators — one less
+state buffer per parameter, same math."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class Adam(Optimizer):
+    _acc_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, name=None,
+                 multi_precision=False, amsgrad=False):
+        super().__init__(
+            learning_rate=learning_rate,
+            parameters=parameters,
+            weight_decay=weight_decay,
+            grad_clip=grad_clip,
+            name=name,
+            multi_precision=multi_precision,
+        )
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+        self._amsgrad = bool(amsgrad)
+        if amsgrad:
+            self._acc_names = ("moment1", "moment2", "moment2_max")
+
+    def _init_state(self, p):
+        st = {
+            "moment1": jnp.zeros_like(p),
+            "moment2": jnp.zeros_like(p),
+        }
+        if self._amsgrad:
+            st["moment2_max"] = jnp.zeros_like(p)
+        return st
+
+    def _adam_core(self, p, g, m, v, lr, t):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * jnp.square(g)
+        # lr_t = lr * sqrt(1-b2^t) / (1-b1^t): same rescaled form the
+        # reference kernel uses (adam_functors.h), fusing both corrections.
+        lr_t = lr * jnp.sqrt(1 - jnp.power(b2, t)) / (1 - jnp.power(b1, t))
+        return m, v, lr_t
+
+    def _update(self, p, g, state, lr, t, attr):
+        m, v, lr_t = self._adam_core(
+            p, g, state["moment1"], state["moment2"], lr, t
+        )
+        new_state = {"moment1": m, "moment2": v}
+        denom_v = v
+        if self._amsgrad:
+            v_max = jnp.maximum(state["moment2_max"], v)
+            new_state["moment2_max"] = v_max
+            denom_v = v_max
+        new_p = p - lr_t * m / (jnp.sqrt(denom_v) + self._epsilon)
+        return new_p, new_state

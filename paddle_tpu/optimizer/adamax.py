@@ -1,0 +1,35 @@
+"""Adamax (ref: python/paddle/optimizer/adamax.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class Adamax(Optimizer):
+    _acc_names = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        super().__init__(
+            learning_rate=learning_rate,
+            parameters=parameters,
+            weight_decay=weight_decay,
+            grad_clip=grad_clip,
+            name=name,
+            multi_precision=multi_precision,
+        )
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros_like(p), "inf_norm": jnp.zeros_like(p)}
+
+    def _update(self, p, g, state, lr, t, attr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(g) + eps)
+        new_p = p - lr / (1 - jnp.power(b1, t)) * m / u
+        return new_p, {"moment": m, "inf_norm": u}

@@ -1,0 +1,100 @@
+"""AdamW — decoupled weight decay (ref: python/paddle/optimizer/adamw.py:32).
+
+``weight_decay`` here is the decoupled coefficient (applied directly to the
+parameter, scaled by lr), NOT a coupled regularizer; ``apply_decay_param_fun``
+filters which params decay, matching the reference's API.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .adam import Adam
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        # weight_decay deliberately NOT forwarded to the base class: it is
+        # decoupled, not a grad-coupled regularizer.
+        super().__init__(
+            learning_rate=learning_rate,
+            beta1=beta1,
+            beta2=beta2,
+            epsilon=epsilon,
+            parameters=parameters,
+            weight_decay=None,
+            grad_clip=grad_clip,
+            name=name,
+            multi_precision=multi_precision,
+            amsgrad=amsgrad,
+        )
+        self._coeff = float(weight_decay)
+        self._lr_ratio = lr_ratio
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._decay_names = None
+
+    def _group_weight_decay(self, group):
+        # Per-group "weight_decay" in AdamW stays decoupled; never coupled.
+        return None, 0.0
+
+    def _collect(self):
+        triples = super()._collect()
+        # Record, positionally, which params decay this step (static mask).
+        self._decay_names = tuple(
+            self._apply_decay_param_fun(p.name)
+            if self._apply_decay_param_fun is not None
+            else True
+            for p, _, _ in triples
+        )
+        self._lr_ratios = tuple(
+            float(self._lr_ratio(p)) if self._lr_ratio is not None else 1.0
+            for p, _, _ in triples
+        )
+        return triples
+
+
+    def _make_step_fn(self):
+        clip = self._grad_clip
+
+        def step_fn(attrs, decay_mask, lr_ratios, lr, t, found_inf,
+                    params, grads, states):
+            if clip is not None:
+                grads = clip._clip_arrays(
+                    params, grads, [a.need_clip for a in attrs]
+                )
+            new_params, new_states = [], []
+            for i, (p, g, s, a) in enumerate(
+                zip(params, grads, states, attrs)
+            ):
+                compute_p = s["master_weight"] if a.multi_precision else p
+                g = g.astype(compute_p.dtype)
+                eff_lr = lr * a.lr_scale * lr_ratios[i]
+                if decay_mask[i] and self._coeff != 0.0:
+                    compute_p = compute_p * (1.0 - eff_lr * self._coeff)
+                np_, ns = self._update(compute_p, g, s, eff_lr, t, a)
+                if a.multi_precision:
+                    ns = dict(ns)
+                    ns["master_weight"] = np_
+                    np_ = np_.astype(p.dtype)
+                np_ = jnp.where(found_inf, p, np_)
+                ns = {
+                    k: jnp.where(found_inf, s[k], v) if k in s else v
+                    for k, v in ns.items()
+                }
+                new_params.append(np_)
+                new_states.append(ns)
+            return new_params, new_states
+
+        jitted = jax.jit(step_fn, static_argnums=(0, 1, 2))
+
+        def wrapper(attrs, lr, t, found_inf, params, grads, states):
+            return jitted(
+                attrs, self._decay_names, self._lr_ratios,
+                lr, t, found_inf, params, grads, states,
+            )
+
+        return wrapper

@@ -1,0 +1,44 @@
+"""ASGD — averaged SGD (ref: python/paddle/optimizer/asgd.py). Maintains the
+running Polyak average of the iterates in ``avg_param``; ``finalize()`` swaps
+the averages into the live parameters."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import autograd
+from .optimizer import Optimizer
+
+
+class ASGD(Optimizer):
+    _acc_names = ("avg_param",)
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        super().__init__(
+            learning_rate=learning_rate,
+            parameters=parameters,
+            weight_decay=weight_decay,
+            grad_clip=grad_clip,
+            name=name,
+            multi_precision=multi_precision,
+        )
+        self._batch_num = int(batch_num)
+
+    def _init_state(self, p):
+        return {"avg_param": p}
+
+    def _update(self, p, g, state, lr, t, attr):
+        new_p = p - lr * g
+        # running average over the window: a_t = a + (p - a) / min(t, n)
+        n = jnp.minimum(t, float(max(self._batch_num, 1)))
+        avg = state["avg_param"] + (new_p - state["avg_param"]) / n
+        return new_p, {"avg_param": avg}
+
+    @autograd.no_grad()
+    def finalize(self):
+        """Copy the averaged parameters into the model."""
+        for p in self._parameter_list:
+            st = self._accumulators.get(id(p))
+            if st and "avg_param" in st:
+                p._rebind(st["avg_param"].astype(p._data.dtype))

@@ -1,0 +1,63 @@
+"""LAMB — layerwise adaptive large-batch optimizer
+(ref: python/paddle/optimizer/lamb.py; phi/kernels/funcs adamw/lamb functors).
+Trust ratio r = ||p|| / ||update|| rescales the Adam step per layer."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class Lamb(Optimizer):
+    _acc_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None, multi_precision=False):
+        super().__init__(
+            learning_rate=learning_rate,
+            parameters=parameters,
+            weight_decay=None,
+            grad_clip=grad_clip,
+            name=name,
+            multi_precision=multi_precision,
+        )
+        self._lamb_weight_decay = float(lamb_weight_decay)
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._exclude_mask = ()
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+
+    def _collect(self):
+        triples = super()._collect()
+        self._exclude_mask = tuple(
+            bool(self._exclude_fn(p)) if self._exclude_fn is not None else False
+            for p, _, _ in triples
+        )
+        self._collect_index = 0
+        return triples
+
+    def _update(self, p, g, state, lr, t, attr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        i = self._collect_index
+        self._collect_index += 1
+        excluded = self._exclude_mask[i] if i < len(self._exclude_mask) else False
+        wd = 0.0 if excluded else self._lamb_weight_decay
+
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        m_hat = m / (1 - jnp.power(b1, t))
+        v_hat = v / (1 - jnp.power(b2, t))
+        update = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+        trust = jnp.where(
+            (p_norm > 0) & (u_norm > 0), p_norm / u_norm, 1.0
+        )
+        return p - lr * trust * update, {"moment1": m, "moment2": v}

@@ -1,0 +1,36 @@
+"""Momentum SGD (ref: python/paddle/optimizer/momentum.py — velocity
+accumulator, optional Nesterov)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class Momentum(Optimizer):
+    _acc_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, multi_precision=False):
+        super().__init__(
+            learning_rate=learning_rate,
+            parameters=parameters,
+            weight_decay=weight_decay,
+            grad_clip=grad_clip,
+            name=name,
+            multi_precision=multi_precision,
+        )
+        self._momentum = float(momentum)
+        self._use_nesterov = bool(use_nesterov)
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def _update(self, p, g, state, lr, t, attr):
+        v = self._momentum * state["velocity"] + g
+        if self._use_nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}

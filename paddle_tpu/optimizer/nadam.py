@@ -1,0 +1,54 @@
+"""NAdam (ref: python/paddle/optimizer/nadam.py — Nesterov-momentum Adam
+with the mu-product schedule). mu_product is a device scalar carried in
+state (same for every param; kept per-param to stay a pure pytree update)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class NAdam(Optimizer):
+    _acc_names = ("moment1", "moment2", "mu_product")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        super().__init__(
+            learning_rate=learning_rate,
+            parameters=parameters,
+            weight_decay=weight_decay,
+            grad_clip=grad_clip,
+            name=name,
+            multi_precision=multi_precision,
+        )
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+        self._momentum_decay = float(momentum_decay)
+
+    def _init_state(self, p):
+        return {
+            "moment1": jnp.zeros_like(p),
+            "moment2": jnp.zeros_like(p),
+            "mu_product": jnp.ones((), jnp.float32),
+        }
+
+    def _update(self, p, g, state, lr, t, attr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        psi = self._momentum_decay
+        mu_t = b1 * (1 - 0.5 * jnp.power(0.96, t * psi))
+        mu_t1 = b1 * (1 - 0.5 * jnp.power(0.96, (t + 1) * psi))
+        mu_prod = state["mu_product"] * mu_t
+        mu_prod_next = mu_prod * mu_t1
+
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        m_hat = (
+            mu_t1 * m / (1 - mu_prod_next)
+            + (1 - mu_t) * g / (1 - mu_prod)
+        )
+        v_hat = v / (1 - jnp.power(b2, t))
+        new_p = p - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+        return new_p, {"moment1": m, "moment2": v, "mu_product": mu_prod}

@@ -1,0 +1,52 @@
+"""RAdam — rectified Adam (ref: python/paddle/optimizer/radam.py). The
+rectification term is a pure function of the step scalar, so it folds into
+the staged update with no extra state."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class RAdam(Optimizer):
+    _acc_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        super().__init__(
+            learning_rate=learning_rate,
+            parameters=parameters,
+            weight_decay=weight_decay,
+            grad_clip=grad_clip,
+            name=name,
+            multi_precision=multi_precision,
+        )
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+
+    def _update(self, p, g, state, lr, t, attr):
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        m_hat = m / (1 - jnp.power(b1, t))
+
+        rho_inf = 2.0 / (1.0 - b2) - 1.0
+        b2t = jnp.power(b2, t)
+        rho_t = rho_inf - 2.0 * t * b2t / (1.0 - b2t)
+        r_num = (rho_t - 4.0) * (rho_t - 2.0) * rho_inf
+        r_den = (rho_inf - 4.0) * (rho_inf - 2.0) * rho_t
+        # guard the sqrt against the unrectified region (rho_t <= 5)
+        r_t = jnp.sqrt(jnp.maximum(r_num / r_den, 0.0))
+        v_hat = jnp.sqrt(v / (1.0 - b2t))
+
+        adaptive = p - lr * r_t * m_hat / (v_hat + eps)
+        sgd_like = p - lr * m_hat
+        return jnp.where(rho_t > 5.0, adaptive, sgd_like), {
+            "moment1": m,
+            "moment2": v,
+        }

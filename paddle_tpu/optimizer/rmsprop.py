@@ -1,0 +1,52 @@
+"""RMSProp (ref: python/paddle/optimizer/rmsprop.py — centered variant +
+momentum accumulator)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class RMSProp(Optimizer):
+    _acc_names = ("momentum", "mean_square", "mean_grad")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        if learning_rate is None:
+            raise ValueError("learning_rate is not set")
+        super().__init__(
+            learning_rate=learning_rate,
+            parameters=parameters,
+            weight_decay=weight_decay,
+            grad_clip=grad_clip,
+            name=name,
+            multi_precision=multi_precision,
+        )
+        self._rho = float(rho)
+        self._epsilon = float(epsilon)
+        self._momentum = float(momentum)
+        self._centered = bool(centered)
+
+    def _init_state(self, p):
+        st = {
+            "momentum": jnp.zeros_like(p),
+            "mean_square": jnp.zeros_like(p),
+        }
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(p)
+        return st
+
+    def _update(self, p, g, state, lr, t, attr):
+        rho, eps, mom = self._rho, self._epsilon, self._momentum
+        ms = rho * state["mean_square"] + (1 - rho) * jnp.square(g)
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = rho * state["mean_grad"] + (1 - rho) * g
+            new_state["mean_grad"] = mg
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+        else:
+            denom = jnp.sqrt(ms + eps)
+        v = mom * state["momentum"] + lr * g / denom
+        new_state["momentum"] = v
+        return p - v, new_state

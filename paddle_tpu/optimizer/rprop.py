@@ -1,0 +1,49 @@
+"""Rprop — resilient backpropagation (ref: python/paddle/optimizer/rprop.py).
+Per-element step sizes adapted by gradient sign agreement; full-batch only."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class Rprop(Optimizer):
+    _acc_names = ("prev_grad", "learning_rate_elem")
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 name=None, multi_precision=False):
+        super().__init__(
+            learning_rate=learning_rate,
+            parameters=parameters,
+            weight_decay=None,
+            grad_clip=grad_clip,
+            name=name,
+            multi_precision=multi_precision,
+        )
+        self._lr_min = float(learning_rate_range[0])
+        self._lr_max = float(learning_rate_range[1])
+        self._eta_minus = float(etas[0])
+        self._eta_plus = float(etas[1])
+        self._initial_lr = float(
+            learning_rate if isinstance(learning_rate, (int, float)) else 0.001
+        )
+
+    def _init_state(self, p):
+        return {
+            "prev_grad": jnp.zeros_like(p),
+            "learning_rate_elem": jnp.full_like(p, self._initial_lr),
+        }
+
+    def _update(self, p, g, state, lr, t, attr):
+        sign = jnp.sign(g * state["prev_grad"])
+        factor = jnp.where(
+            sign > 0, self._eta_plus, jnp.where(sign < 0, self._eta_minus, 1.0)
+        )
+        lre = jnp.clip(
+            state["learning_rate_elem"] * factor, self._lr_min, self._lr_max
+        )
+        # sign-flip elements take no step and zero their history
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_p = p - lre * jnp.sign(g_eff)
+        return new_p, {"prev_grad": g_eff, "learning_rate_elem": lre}

@@ -1,0 +1,37 @@
+"""Weight-decay regularizers (ref: python/paddle/regularizer.py L1Decay/L2Decay).
+
+Pure-array form: ``_apply(param, grad) -> grad`` runs inside the staged
+optimizer update.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]
+
+
+class WeightDecayRegularizer:
+    def _apply(self, p, g):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __str__(self):
+        return f"L1Decay, coeff={self.coeff}"
+
+    def _apply(self, p, g):
+        return g + self.coeff * jnp.sign(p).astype(g.dtype)
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __str__(self):
+        return f"L2Decay, coeff={self.coeff}"
+
+    def _apply(self, p, g):
+        return g + self.coeff * p.astype(g.dtype)
