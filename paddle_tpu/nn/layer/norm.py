@@ -1,0 +1,370 @@
+"""Normalization layers (ref: python/paddle/nn/layer/norm.py — _BatchNormBase,
+BatchNorm1D/2D/3D, LayerNorm, RMSNorm, GroupNorm, InstanceNorm*,
+LocalResponseNorm, SpectralNorm).
+
+TPU note: running-stat updates rebind the buffer payloads (jax.Arrays are
+immutable) through the batch_norm_with_stats op so the whole norm records as
+one tape entry and stages cleanly under jit.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ... import ops as F
+from ...core.tensor import Tensor
+from .. import initializer as I
+from ..parameter import ParamAttr
+from .layers import Layer
+
+__all__ = [
+    "BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+    "SyncBatchNorm", "LayerNorm", "RMSNorm", "GroupNorm",
+    "InstanceNorm1D", "InstanceNorm2D", "InstanceNorm3D",
+    "LocalResponseNorm", "SpectralNorm",
+]
+
+
+def _make_scale_bias(layer, num_features, weight_attr, bias_attr, dtype):
+    if weight_attr is False:
+        layer.weight = None
+        layer.add_parameter("weight", None)
+    else:
+        attr = ParamAttr._to_attr(weight_attr)
+        if attr.initializer is None:
+            attr.initializer = I.Constant(1.0)
+        layer.weight = layer.create_parameter(
+            shape=[num_features], attr=attr, dtype=dtype
+        )
+    if bias_attr is False:
+        layer.bias = None
+        layer.add_parameter("bias", None)
+    else:
+        battr = ParamAttr._to_attr(bias_attr)
+        if battr.initializer is None:
+            battr.initializer = I.Constant(0.0)
+        layer.bias = layer.create_parameter(
+            shape=[num_features], attr=battr, is_bias=True, dtype=dtype
+        )
+
+
+class _BatchNormBase(Layer):
+    _expected_ndim = None
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        _make_scale_bias(self, num_features, weight_attr, bias_attr, "float32")
+        mean = Tensor(np.zeros(num_features, np.float32))
+        var = Tensor(np.ones(num_features, np.float32))
+        self.register_buffer("_mean", mean)
+        self.register_buffer("_variance", var)
+
+    def forward(self, x):
+        if self._expected_ndim is not None and x.ndim != self._expected_ndim:
+            raise ValueError(
+                f"expected {self._expected_ndim}D input, got {x.ndim}D"
+            )
+        use_global = (
+            self._use_global_stats
+            if self._use_global_stats is not None
+            else not self.training
+        )
+        if use_global:
+            return F.batch_norm(
+                x, self._mean, self._variance, self.weight, self.bias,
+                False, self._momentum, self._epsilon, self._data_format,
+                True,
+            )
+        out, new_mean, new_var = F.batch_norm_with_stats(
+            x, self._mean, self._variance, self.weight, self.bias,
+            self._momentum, self._epsilon, self._data_format,
+        )
+        # buffer update: detached — running stats never join the tape
+        self._mean._rebind(new_mean.detach()._data)
+        self._variance._rebind(new_var.detach()._data)
+        return out
+
+    def extra_repr(self):
+        return (
+            f"num_features={self._num_features}, momentum={self._momentum}, "
+            f"epsilon={self._epsilon}"
+        )
+
+
+class BatchNorm(_BatchNormBase):
+    """Unversioned alias accepting any rank (ref: nn/layer/norm.py BatchNorm)."""
+
+
+class BatchNorm1D(_BatchNormBase):
+    def forward(self, x):
+        if x.ndim == 2:
+            # [N, C] -> treat as [N, C, 1]
+            x3 = F.unsqueeze(x, -1)
+            out = super().forward(x3)
+            return F.squeeze(out, -1)
+        if x.ndim != 3:
+            raise ValueError(f"BatchNorm1D expects 2D/3D input, got {x.ndim}D")
+        return super().forward(x)
+
+
+class BatchNorm2D(_BatchNormBase):
+    _expected_ndim = 4
+
+
+class BatchNorm3D(_BatchNormBase):
+    _expected_ndim = 5
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica BatchNorm (ref: nn/layer/norm.py SyncBatchNorm over
+    NCCL). Under GSPMD data parallelism the batch axis is sharded, and XLA
+    computes batch statistics with cross-replica collectives automatically
+    when the reduction spans the sharded axis — so the math here is the
+    plain batch_norm; the sync comes from the sharding propagation."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        out = layer
+        if isinstance(layer, _BatchNormBase) and not isinstance(
+            layer, SyncBatchNorm
+        ):
+            out = SyncBatchNorm(
+                layer._num_features, layer._momentum, layer._epsilon,
+                data_format=layer._data_format,
+            )
+            if layer.weight is not None:
+                out.weight = layer.weight
+            if layer.bias is not None:
+                out.bias = layer.bias
+            out._mean = layer._mean
+            out._variance = layer._variance
+        for name, sub in layer.named_children():
+            new_sub = cls.convert_sync_batchnorm(sub)
+            if new_sub is not sub:
+                setattr(out, name, new_sub)
+        return out
+
+
+class LayerNorm(Layer):
+    """ref: nn/layer/norm.py LayerNorm; phi LayerNormInferMeta."""
+
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, numbers.Integral):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        n = int(np.prod(self._normalized_shape))
+        if weight_attr is False:
+            self.weight = None
+            self.add_parameter("weight", None)
+        else:
+            attr = ParamAttr._to_attr(weight_attr)
+            if attr.initializer is None:
+                attr.initializer = I.Constant(1.0)
+            self.weight = self.create_parameter(
+                shape=self._normalized_shape, attr=attr
+            )
+        if bias_attr is False:
+            self.bias = None
+            self.add_parameter("bias", None)
+        else:
+            battr = ParamAttr._to_attr(bias_attr)
+            if battr.initializer is None:
+                battr.initializer = I.Constant(0.0)
+            self.bias = self.create_parameter(
+                shape=self._normalized_shape, attr=battr, is_bias=True
+            )
+
+    def forward(self, x):
+        return F.layer_norm(
+            x, self.weight, self.bias, self._normalized_shape, self._epsilon
+        )
+
+    def extra_repr(self):
+        return (
+            f"normalized_shape={self._normalized_shape}, "
+            f"epsilon={self._epsilon}"
+        )
+
+
+class RMSNorm(Layer):
+    """ref: incubate/nn/functional/fused_rms_norm.py + nn RMSNorm — the
+    Llama-family norm; fp32 accumulation inside the op."""
+
+    def __init__(self, normalized_shape, epsilon=1e-6, weight_attr=None,
+                 bias_attr=False, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, numbers.Integral):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        attr = ParamAttr._to_attr(weight_attr)
+        if attr.initializer is None:
+            attr.initializer = I.Constant(1.0)
+        self.weight = self.create_parameter(
+            shape=self._normalized_shape, attr=attr
+        )
+        if bias_attr is False or bias_attr is None:
+            self.bias = None
+            self.add_parameter("bias", None)
+        else:
+            battr = ParamAttr._to_attr(bias_attr)
+            if battr.initializer is None:
+                battr.initializer = I.Constant(0.0)
+            self.bias = self.create_parameter(
+                shape=self._normalized_shape, attr=battr, is_bias=True
+            )
+
+    def forward(self, x):
+        return F.rms_norm(
+            x, self.weight, self.bias, self._epsilon,
+            -len(self._normalized_shape),
+        )
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}"
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        if num_channels % num_groups != 0:
+            raise ValueError("num_channels must be divisible by num_groups")
+        self._num_groups = num_groups
+        self._num_channels = num_channels
+        self._epsilon = epsilon
+        self._data_format = data_format
+        _make_scale_bias(self, num_channels, weight_attr, bias_attr, "float32")
+
+    def forward(self, x):
+        return F.group_norm(
+            x, self.weight, self.bias, self._num_groups, self._epsilon,
+            self._data_format,
+        )
+
+    def extra_repr(self):
+        return (
+            f"num_groups={self._num_groups}, "
+            f"num_channels={self._num_channels}"
+        )
+
+
+class _InstanceNormBase(Layer):
+    _expected_ndim = None
+
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._epsilon = epsilon
+        self._data_format = data_format
+        if weight_attr is False or bias_attr is False:
+            self.scale = None
+            self.bias = None
+            self.add_parameter("scale", None)
+            self.add_parameter("bias", None)
+        else:
+            attr = ParamAttr._to_attr(weight_attr)
+            if attr.initializer is None:
+                attr.initializer = I.Constant(1.0)
+            self.scale = self.create_parameter(
+                shape=[num_features], attr=attr
+            )
+            battr = ParamAttr._to_attr(bias_attr)
+            if battr.initializer is None:
+                battr.initializer = I.Constant(0.0)
+            self.bias = self.create_parameter(
+                shape=[num_features], attr=battr, is_bias=True
+            )
+
+    def forward(self, x):
+        if self._expected_ndim is not None and x.ndim != self._expected_ndim:
+            raise ValueError(
+                f"expected {self._expected_ndim}D input, got {x.ndim}D"
+            )
+        return F.instance_norm(
+            x, self.scale, self.bias, self._epsilon, self._data_format
+        )
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}, epsilon={self._epsilon}"
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    _expected_ndim = 3
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    _expected_ndim = 4
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    _expected_ndim = 5
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.local_response_norm(
+            x, self.size, self.alpha, self.beta, self.k, self._data_format
+        )
+
+
+class SpectralNorm(Layer):
+    """Power-iteration spectral normalization of a weight tensor
+    (ref: nn/layer/norm.py SpectralNorm)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._epsilon = epsilon
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        rng = np.random.RandomState(0)
+        u = rng.normal(0, 1, h).astype(np.float32)
+        v = rng.normal(0, 1, w).astype(np.float32)
+        self.register_buffer("weight_u", Tensor(u))
+        self.register_buffer("weight_v", Tensor(v))
+
+    def forward(self, weight):
+        import jax.numpy as jnp
+
+        w = weight._data
+        if self._dim != 0:
+            w = jnp.moveaxis(w, self._dim, 0)
+        h = w.shape[0]
+        mat = w.reshape(h, -1)
+        u, v = self.weight_u._data, self.weight_v._data
+        for _ in range(self._power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self._epsilon)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self._epsilon)
+        self.weight_u._rebind(u)
+        self.weight_v._rebind(v)
+        sigma = u @ mat @ v
+        out = weight / Tensor(sigma, stop_gradient=True)
+        return out

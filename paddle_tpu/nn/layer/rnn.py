@@ -1,0 +1,329 @@
+"""Recurrent layers (ref: python/paddle/nn/layer/rnn.py — RNNCellBase:152,
+SimpleRNNCell:271, LSTMCell:404, GRUCell:569, RNN:723, BiRNN:810,
+SimpleRNN/LSTM/GRU over _RNNBase:1211).
+
+TPU-first: the multi-layer classes call the fused `rnn` op (one lax.scan per
+layer/direction inside a single tape entry) rather than a Python loop over
+cells; the cell classes remain for single-step use and the generic RNN
+wrapper.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import ops as F
+from .. import initializer as I
+from ..parameter import ParamAttr
+from .layers import Layer
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+    "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU",
+]
+
+
+class RNNCellBase(Layer):
+    """ref: nn/layer/rnn.py:152. get_initial_states builds zero states."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        hidden = self.hidden_size
+        state_shape = getattr(self, "state_shape", (hidden,))
+        if isinstance(state_shape, tuple) and state_shape and isinstance(
+            state_shape[0], (tuple, list)
+        ):
+            return tuple(
+                F.full([batch] + list(s), init_value, dtype or "float32")
+                for s in state_shape
+            )
+        return F.full(
+            [batch] + list(state_shape), init_value, dtype or "float32"
+        )
+
+
+def _cell_params(layer, input_size, hidden_size, n_gates, weight_ih_attr,
+                 weight_hh_attr, bias_ih_attr, bias_hh_attr):
+    std = 1.0 / np.sqrt(hidden_size)
+    for name, shape, attr_in in (
+        ("weight_ih", [n_gates * hidden_size, input_size], weight_ih_attr),
+        ("weight_hh", [n_gates * hidden_size, hidden_size], weight_hh_attr),
+    ):
+        attr = ParamAttr._to_attr(attr_in)
+        if attr.initializer is None:
+            attr.initializer = I.Uniform(-std, std)
+        setattr(layer, name, layer.create_parameter(shape=shape, attr=attr))
+    for name, attr_in in (
+        ("bias_ih", bias_ih_attr),
+        ("bias_hh", bias_hh_attr),
+    ):
+        if attr_in is False:
+            setattr(layer, name, None)
+            layer.add_parameter(name, None)
+            continue
+        attr = ParamAttr._to_attr(attr_in)
+        if attr.initializer is None:
+            attr.initializer = I.Uniform(-std, std)
+        setattr(
+            layer,
+            name,
+            layer.create_parameter(
+                shape=[n_gates * hidden_size], attr=attr, is_bias=True
+            ),
+        )
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        _cell_params(self, input_size, hidden_size, 1, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = states
+        z = F.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            z = z + self.bias_ih
+        z = z + F.matmul(h, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            z = z + self.bias_hh
+        h_new = F.tanh(z) if self.activation == "tanh" else F.relu(z)
+        return h_new, h_new
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        _cell_params(self, input_size, hidden_size, 4, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        gates = F.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            gates = gates + self.bias_ih
+        gates = gates + F.matmul(h, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            gates = gates + self.bias_hh
+        i, f, g, o = F.split(gates, 4, axis=-1)
+        i = F.sigmoid(i)
+        f = F.sigmoid(f)
+        g = F.tanh(g)
+        o = F.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * F.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        _cell_params(self, input_size, hidden_size, 3, weight_ih_attr,
+                     weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = states
+        gi = F.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            gi = gi + self.bias_ih
+        gh = F.matmul(h, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            gh = gh + self.bias_hh
+        ri, zi, ci = F.split(gi, 3, axis=-1)
+        rh, zh, ch = F.split(gh, 3, axis=-1)
+        r = F.sigmoid(ri + rh)
+        z = F.sigmoid(zi + zh)
+        c = F.tanh(ci + r * ch)
+        h_new = (F.ones_like(z) - z) * c + z * h
+        return h_new, h_new
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Generic cell-driven sweep (ref: nn/layer/rnn.py:723). Python loop —
+    use SimpleRNN/LSTM/GRU for the fused scan path."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        axis = 0 if self.time_major else 1
+        steps = inputs.shape[axis]
+        states = (
+            initial_states
+            if initial_states is not None
+            else self.cell.get_initial_states(
+                inputs, batch_dim_idx=1 if self.time_major else 0
+            )
+        )
+        outs = []
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in order:
+            xt = (
+                F.getitem(inputs, (t,))
+                if self.time_major
+                else F.getitem(inputs, (slice(None), t))
+            )
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        output = F.stack(outs, axis=axis)
+        return output, states
+
+
+class BiRNN(Layer):
+    """ref: nn/layer/rnn.py:810 — forward + backward cells, concat outputs."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if initial_states is None:
+            states_fw = states_bw = None
+        else:
+            states_fw, states_bw = initial_states
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        return F.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer fused path over the `rnn` op (ref: nn/layer/rnn.py:1211
+    _RNNBase driving _C_ops.rnn)."""
+
+    _mode = "LSTM"
+    _n_gates = 4
+    _n_states = 2
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"bad direction {direction!r}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.time_major = time_major
+        self.dropout = dropout
+        d = 2 if self.bidirectional else 1
+
+        std = 1.0 / np.sqrt(hidden_size)
+        self._flat_names = []
+        for layer in range(num_layers):
+            for direction_i in range(d):
+                in_size = input_size if layer == 0 else hidden_size * d
+                suffix = f"_l{layer}" + ("_reverse" if direction_i else "")
+                for base, shape, attr_in, is_bias in (
+                    ("weight_ih", [self._n_gates * hidden_size, in_size],
+                     weight_ih_attr, False),
+                    ("weight_hh", [self._n_gates * hidden_size, hidden_size],
+                     weight_hh_attr, False),
+                    ("bias_ih", [self._n_gates * hidden_size],
+                     bias_ih_attr, True),
+                    ("bias_hh", [self._n_gates * hidden_size],
+                     bias_hh_attr, True),
+                ):
+                    attr = ParamAttr._to_attr(attr_in)
+                    if attr.initializer is None:
+                        attr.initializer = I.Uniform(-std, std)
+                    p = self.create_parameter(
+                        shape=shape, attr=attr, is_bias=is_bias
+                    )
+                    name = base + suffix
+                    self.add_parameter(name, p)
+                    self._flat_names.append(name)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        d = 2 if self.bidirectional else 1
+        batch = inputs.shape[0 if not self.time_major else 1]
+        if initial_states is None:
+            h0 = F.zeros(
+                [self.num_layers * d, batch, self.hidden_size], inputs.dtype
+            )
+            initial_states = (
+                (h0, F.zeros_like(h0)) if self._n_states == 2 else (h0,)
+            )
+        elif not isinstance(initial_states, (tuple, list)):
+            initial_states = (initial_states,)
+
+        weights = [getattr(self, n) for n in self._flat_names]
+        res = F.rnn(
+            inputs, list(initial_states), weights, self._mode,
+            self.num_layers, self.time_major, self.dropout,
+            self.bidirectional, self.training,
+        )
+        out = res[0]
+        if self._n_states == 2:
+            return out, (res[1], res[2])
+        return out, res[1]
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        self._mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        self._n_gates = 1
+        self._n_states = 1
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kw)
+
+
+class LSTM(_RNNBase):
+    _mode = "LSTM"
+    _n_gates = 4
+    _n_states = 2
+
+
+class GRU(_RNNBase):
+    _mode = "GRU"
+    _n_gates = 3
+    _n_states = 1
+
+    def __init__(self, *args, **kw):
+        self._n_gates = 3
+        self._n_states = 1
+        super().__init__(*args, **kw)

@@ -35,6 +35,8 @@ COERCE = {
     "IntArray": "_int_array",
     "Scalar": "_scalar",
     "DataType": "_dtype_attr",
+    # int -> kept as int (count); list/Tensor -> list of ints (sections)
+    "Sections": "_sections",
 }
 
 _ARG_RE = re.compile(
@@ -195,6 +197,18 @@ def _dtype_attr(v):
     if v is None:
         return None
     return _convert_dtype(v).name
+
+
+def _sections(v):
+    """num_or_sections: plain int = section count (kept as int); list or
+    Tensor = explicit section sizes (normalized to list[int])."""
+    if isinstance(v, _Tensor):
+        return [int(i) for i in v.numpy().reshape(-1).tolist()]
+    if isinstance(v, (list, tuple)):
+        return [
+            int(i.item()) if isinstance(i, _Tensor) else int(i) for i in v
+        ]
+    return int(v)
 
 
 def _inplace_rebind(x, out):

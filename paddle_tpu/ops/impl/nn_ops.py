@@ -819,3 +819,52 @@ def scaled_dot_product_attention(
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     return jnp.swapaxes(out, 1, 2).astype(query.dtype)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, *,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=False):
+    """Pure functional batch_norm (ref: nn/functional/norm.py batch_norm).
+    Uses batch statistics when training (unless use_global_stats); running
+    stats are NOT mutated here — the BatchNorm layer owns that state and
+    calls batch_norm_with_stats."""
+    if training and not use_global_stats:
+        out, _, _ = batch_norm_train(
+            x, running_mean, running_var, weight, bias,
+            momentum=momentum, epsilon=epsilon, data_format=data_format,
+        )
+        return out
+    return batch_norm_infer(
+        x, running_mean, running_var, weight, bias,
+        epsilon=epsilon, data_format=data_format,
+    )
+
+
+def bilinear(x1, x2, weight, bias=None):
+    """out[n,o] = x1[n,:] @ W[o] @ x2[n,:] + b (ref: nn/functional/common.py
+    bilinear; phi BilinearInferMeta)."""
+    out = jnp.einsum("ni,oij,nj->no", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def dropout2d(x, *, key, p=0.5, training=True, data_format="NCHW"):
+    """Channel-wise dropout on 4-D input (ref: nn/functional/common.py
+    dropout2d — zeroes whole channels)."""
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, key=key, p=p, training=training, axis=axis)
+
+
+def dropout3d(x, *, key, p=0.5, training=True, data_format="NCDHW"):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, key=key, p=p, training=training, axis=axis)
+
+
+def upsample(x, *, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW"):
+    """Alias of interpolate (ref: nn/functional/common.py upsample)."""
+    return interpolate(
+        x, size=size, scale_factor=scale_factor, mode=mode,
+        align_corners=align_corners, data_format=data_format,
+    )

@@ -1,13 +1,13 @@
 """AdamW — decoupled weight decay (ref: python/paddle/optimizer/adamw.py:32).
 
 ``weight_decay`` here is the decoupled coefficient (applied directly to the
-parameter, scaled by lr), NOT a coupled regularizer; ``apply_decay_param_fun``
-filters which params decay, matching the reference's API.
+parameter, scaled by lr) rather than a grad-coupled regularizer;
+``apply_decay_param_fun`` filters which params decay and ``lr_ratio`` scales
+per-param learning rates (the layerwise-decay hook), matching the
+reference's API. Both fold into the base class's staged update through the
+``_param_extras`` hook — param-level coupled regularizers still apply.
 """
 from __future__ import annotations
-
-import jax
-import jax.numpy as jnp
 
 from .adam import Adam
 
@@ -35,66 +35,17 @@ class AdamW(Adam):
         self._coeff = float(weight_decay)
         self._lr_ratio = lr_ratio
         self._apply_decay_param_fun = apply_decay_param_fun
-        self._decay_names = None
 
     def _group_weight_decay(self, group):
-        # Per-group "weight_decay" in AdamW stays decoupled; never coupled.
+        # A per-group "weight_decay" on AdamW is also decoupled, never
+        # coupled; the group coefficient is consumed in _param_extras.
         return None, 0.0
 
-    def _collect(self):
-        triples = super()._collect()
-        # Record, positionally, which params decay this step (static mask).
-        self._decay_names = tuple(
+    def _param_extras(self, p):
+        decay = self._coeff
+        if self._apply_decay_param_fun is not None and not (
             self._apply_decay_param_fun(p.name)
-            if self._apply_decay_param_fun is not None
-            else True
-            for p, _, _ in triples
-        )
-        self._lr_ratios = tuple(
-            float(self._lr_ratio(p)) if self._lr_ratio is not None else 1.0
-            for p, _, _ in triples
-        )
-        return triples
-
-
-    def _make_step_fn(self):
-        clip = self._grad_clip
-
-        def step_fn(attrs, decay_mask, lr_ratios, lr, t, found_inf,
-                    params, grads, states):
-            if clip is not None:
-                grads = clip._clip_arrays(
-                    params, grads, [a.need_clip for a in attrs]
-                )
-            new_params, new_states = [], []
-            for i, (p, g, s, a) in enumerate(
-                zip(params, grads, states, attrs)
-            ):
-                compute_p = s["master_weight"] if a.multi_precision else p
-                g = g.astype(compute_p.dtype)
-                eff_lr = lr * a.lr_scale * lr_ratios[i]
-                if decay_mask[i] and self._coeff != 0.0:
-                    compute_p = compute_p * (1.0 - eff_lr * self._coeff)
-                np_, ns = self._update(compute_p, g, s, eff_lr, t, a)
-                if a.multi_precision:
-                    ns = dict(ns)
-                    ns["master_weight"] = np_
-                    np_ = np_.astype(p.dtype)
-                np_ = jnp.where(found_inf, p, np_)
-                ns = {
-                    k: jnp.where(found_inf, s[k], v) if k in s else v
-                    for k, v in ns.items()
-                }
-                new_params.append(np_)
-                new_states.append(ns)
-            return new_params, new_states
-
-        jitted = jax.jit(step_fn, static_argnums=(0, 1, 2))
-
-        def wrapper(attrs, lr, t, found_inf, params, grads, states):
-            return jitted(
-                attrs, self._decay_names, self._lr_ratios,
-                lr, t, found_inf, params, grads, states,
-            )
-
-        return wrapper
+        ):
+            decay = 0.0
+        ratio = float(self._lr_ratio(p)) if self._lr_ratio is not None else 1.0
+        return decay, ratio

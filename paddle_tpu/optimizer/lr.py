@@ -265,6 +265,20 @@ class MultiplicativeDecay(LRScheduler):
         self.lr_lambda = lr_lambda
         super().__init__(learning_rate, last_epoch, verbose)
 
+    def step(self, epoch=None):
+        if epoch is None:
+            # incremental O(1) path for sequential stepping
+            self.last_epoch += 1
+            if self.last_epoch > 0:
+                self.last_lr = self.last_lr * self.lr_lambda(self.last_epoch)
+            if self.verbose:
+                print(
+                    f"Epoch {self.last_epoch}: MultiplicativeDecay set "
+                    f"learning rate to {self.last_lr}."
+                )
+            return
+        super().step(epoch)
+
     def get_lr(self):
         cur = self.base_lr
         for epoch in range(1, self.last_epoch + 1):

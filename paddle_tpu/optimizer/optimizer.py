@@ -40,6 +40,8 @@ class _PAttr(NamedTuple):
     reg_coeff: float
     need_clip: bool
     multi_precision: bool
+    decoupled_decay: float = 0.0  # AdamW-style p *= (1 - lr*coeff)
+    lr_ratio: float = 1.0  # AdamW lr_ratio(param) hook
 
 
 def _normalize_weight_decay(wd):
@@ -193,6 +195,7 @@ class Optimizer:
                 preg = getattr(p, "regularizer", None)
                 if preg is not None:
                     kind, coeff = _normalize_weight_decay(preg)
+                decoupled, lr_ratio = self._param_extras(p)
                 attr = _PAttr(
                     lr_scale=lr_scale
                     * float(
@@ -202,10 +205,17 @@ class Optimizer:
                     reg_coeff=coeff,
                     need_clip=getattr(p, "need_clip", True),
                     multi_precision=self._use_master(p),
+                    decoupled_decay=decoupled,
+                    lr_ratio=lr_ratio,
                 )
                 g_arr = grad._data if isinstance(grad, Tensor) else jnp.asarray(grad)
                 out.append((p, g_arr, attr))
         return out
+
+    def _param_extras(self, p):
+        """Hook for subclasses: (decoupled_decay_coeff, lr_ratio) baked into
+        the per-param static attrs (AdamW overrides)."""
+        return 0.0, 1.0
 
     def _make_step_fn(self):
         clip = self._grad_clip
@@ -223,9 +233,10 @@ class Optimizer:
                     g = g + a.reg_coeff * compute_p
                 elif a.reg_kind == "l1":
                     g = g + a.reg_coeff * jnp.sign(compute_p)
-                np_, ns = self._update(
-                    compute_p, g, s, lr * a.lr_scale, t, a
-                )
+                eff_lr = lr * a.lr_scale * a.lr_ratio
+                if a.decoupled_decay != 0.0:
+                    compute_p = compute_p * (1.0 - eff_lr * a.decoupled_decay)
+                np_, ns = self._update(compute_p, g, s, eff_lr, t, a)
                 if a.multi_precision:
                     ns = dict(ns)
                     ns["master_weight"] = np_

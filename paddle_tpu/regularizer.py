@@ -1,18 +1,17 @@
 """Weight-decay regularizers (ref: python/paddle/regularizer.py L1Decay/L2Decay).
 
-Pure-array form: ``_apply(param, grad) -> grad`` runs inside the staged
-optimizer update.
+These are configuration carriers: the optimizer's staged update reads
+``(kind, coeff)`` via ``optimizer._normalize_weight_decay`` and fuses the
+grad-coupled decay (g += coeff*p or coeff*sign(p)) into the per-step XLA
+program.
 """
 from __future__ import annotations
-
-import jax.numpy as jnp
 
 __all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]
 
 
 class WeightDecayRegularizer:
-    def _apply(self, p, g):
-        raise NotImplementedError
+    pass
 
 
 class L1Decay(WeightDecayRegularizer):
@@ -22,9 +21,6 @@ class L1Decay(WeightDecayRegularizer):
     def __str__(self):
         return f"L1Decay, coeff={self.coeff}"
 
-    def _apply(self, p, g):
-        return g + self.coeff * jnp.sign(p).astype(g.dtype)
-
 
 class L2Decay(WeightDecayRegularizer):
     def __init__(self, coeff=0.0):
@@ -32,6 +28,3 @@ class L2Decay(WeightDecayRegularizer):
 
     def __str__(self):
         return f"L2Decay, coeff={self.coeff}"
-
-    def _apply(self, p, g):
-        return g + self.coeff * p.astype(g.dtype)

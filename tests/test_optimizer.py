@@ -452,3 +452,29 @@ class TestMisc:
     def test_parameters_required(self):
         with pytest.raises(ValueError):
             opt.SGD(learning_rate=0.1)
+
+
+class TestReviewRegressions:
+    def test_adamw_applies_param_regularizer(self):
+        # per-param coupled regularizer must apply under AdamW too
+        p = _make_param([1.0])
+        p.regularizer = paddle.regularizer.L2Decay(0.5)
+        o = opt.AdamW(learning_rate=0.1, weight_decay=0.0, parameters=[p])
+        _set_grad(p, [0.0])
+        o.step()
+        assert float(p.numpy()[0]) < 1.0  # decayed via coupled reg
+
+    def test_split_tensor_sections(self):
+        x = paddle.to_tensor(np.arange(12).reshape(3, 4).astype(np.float32))
+        parts = paddle.split(x, paddle.to_tensor(np.array([1, 3], np.int32)),
+                             axis=-1)
+        assert [list(p.shape) for p in parts] == [[3, 1], [3, 3]]
+        parts = paddle.split(x, [paddle.to_tensor(np.int32(1)), 2, -1],
+                             axis=-1)
+        assert [list(p.shape) for p in parts] == [[3, 1], [3, 2], [3, 1]]
+
+    def test_multiplicative_decay_incremental(self):
+        s = opt.lr.MultiplicativeDecay(1.0, lr_lambda=lambda e: 0.5)
+        for _ in range(3):
+            s.step()
+        assert abs(s() - 0.125) < 1e-12
