@@ -1,0 +1,129 @@
+"""Driver benchmark: Llama-style decoder pretrain step on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric: model FLOPs utilization (MFU, %) of the jit-staged train step
+(fwd+bwd+AdamW fused into one XLA program, donated buffers, bf16 compute).
+vs_baseline is MFU / 45% — BASELINE.md config #2's north-star target.
+
+Extra diagnostics (eager-vs-jit ratio, tokens/sec) go to stderr so the
+stdout contract stays a single parseable line.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+PEAK_BF16_FLOPS = {
+    # per-chip peak dense bf16 FLOP/s
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = PEAK_BF16_FLOPS.get(gen, 197e12)
+    on_tpu = paddle.is_compiled_with_tpu() and "cpu" not in str(
+        paddle.get_device()
+    )
+
+    # Single-chip benchmark model: ~152M params (GPT-2-medium class),
+    # sized to fit one v5e chip with optimizer state.
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=8, num_attention_heads=16,
+            max_position_embeddings=2048,
+        )
+        batch, seq, steps, warmup = 8, 1024, 10, 3
+    else:  # CPU smoke path so the script always emits its line
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps, warmup = 2, 32, 3, 1
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.bfloat16()
+    n_params = model.num_params()
+    log(f"device={paddle.get_device()} gen={gen} params={n_params/1e6:.1f}M "
+        f"batch={batch} seq={seq}")
+
+    opt = paddle.optimizer.AdamW(
+        learning_rate=3e-4, weight_decay=0.1,
+        parameters=model.parameters(), multi_precision=True,
+    )
+
+    def loss_fn(m, ids):
+        _, loss = m(ids, labels=ids)
+        return loss
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int32")
+    )
+
+    t0 = time.perf_counter()
+    loss = step(ids)
+    float(loss.numpy())
+    log(f"compile+first step: {time.perf_counter()-t0:.1f}s "
+        f"loss={float(loss.numpy()):.3f}")
+    for _ in range(warmup - 1):
+        step(ids)
+    float(step(ids).numpy())  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids)
+    float(loss.numpy())  # device sync
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens = batch * seq
+    tokens_per_sec = tokens / dt
+    # PaLM-appendix MFU accounting: 6N per token (fwd+bwd matmuls) plus
+    # causal attention 12*L*d*s (QK^T and PV, fwd+bwd, halved for causality)
+    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * \
+        cfg.hidden_size * seq * 0.5
+    mfu = tokens_per_sec * flops_per_token / peak
+
+    log(f"step={dt*1e3:.1f}ms tokens/s={tokens_per_sec:,.0f} "
+        f"MFU={mfu*100:.1f}% (peak {peak/1e12:.0f} TF)")
+
+    # eager-vs-jit ratio on a few steps (diagnostic)
+    try:
+        t0 = time.perf_counter()
+        for _ in range(2):
+            l = loss_fn(model, ids)
+            l.backward()
+            opt.step()
+            opt.clear_grad()
+        float(l.numpy())
+        eager_dt = (time.perf_counter() - t0) / 2
+        log(f"eager step={eager_dt*1e3:.0f}ms -> jit speedup "
+            f"{eager_dt/dt:.1f}x")
+    except Exception as e:  # diagnostics must never break the contract
+        log(f"eager comparison skipped: {e}")
+
+    print(json.dumps({
+        "metric": "llama_pretrain_mfu_1chip",
+        "value": round(mfu * 100, 2),
+        "unit": "%",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

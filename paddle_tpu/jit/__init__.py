@@ -1,0 +1,25 @@
+"""paddle.jit analogue — program capture onto XLA.
+
+Re-design of the reference's to_static stack (ref: python/paddle/jit/api.py:197
+StaticFunction/ProgramTranslator; fluid/framework/new_executor
+standalone_executor.h:34) for the XLA compilation model: instead of
+source-to-source AST rewriting + a PIR interpreter, the define-by-run tape is
+*pure traceable Python over jax arrays*, so one `jax.jit` trace captures
+forward + backward + optimizer into a single XLA program with buffer
+donation and a compile cache (XLA plays the role of PIR passes + CINN).
+
+Two entry points:
+  * ``to_static(fn_or_layer)``  — stage any tensor function / Layer forward.
+  * ``TrainStep(model, loss_fn, optimizer)`` — stage the full training step
+    (fwd + bwd + clip + update); parameters and optimizer state are donated
+    so updates happen in-place in device memory.
+"""
+from .api import StaticFunction, TrainStep, ignore_module, not_to_static, to_static
+
+__all__ = [
+    "to_static",
+    "not_to_static",
+    "ignore_module",
+    "StaticFunction",
+    "TrainStep",
+]

@@ -1,0 +1,386 @@
+"""jit staging implementation.
+
+The functionalization contract: eager Tensors are Python objects whose
+payload (`_data`) we swap for tracers during the single trace, then restore.
+Anything the traced body mutates (parameters via the optimizer update,
+buffers via BatchNorm, the RNG key) is lifted to explicit inputs/outputs of
+the staged function — the XLA analogue of the reference's inplace pass +
+variable-scope binding (fluid/pir/transforms/general/inplace_pass.cc;
+new_executor/pir_adaptor value binding).
+
+Because Tensor is pytree-registered, jax.jit moves whole Tensor-bearing
+structures across the staging boundary directly; outputs come back as fresh
+detached Tensors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core import random as random_mod
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+_NOT_TO_STATIC = set()
+
+
+def not_to_static(fn):
+    """Mark a function to stay eager (ref: jit/api.py not_to_static)."""
+    _NOT_TO_STATIC.add(fn)
+    return fn
+
+
+def ignore_module(modules):
+    """API-parity no-op: jax tracing handles arbitrary modules."""
+    return None
+
+
+def _swap_payloads(tensors, arrays):
+    old = [t._data for t in tensors]
+    for t, a in zip(tensors, arrays):
+        t._data = a
+    return old
+
+
+class _rng_lift:
+    """Swap the global generator key for a per-call traced key during
+    staging, so dropout etc. draw from a fresh key every execution instead
+    of a constant baked at trace time."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        self._saved = random_mod.default_generator._key
+        random_mod.default_generator._key = self._key
+        return self
+
+    def final_key(self):
+        return random_mod.default_generator._key
+
+    def __exit__(self, *exc):
+        random_mod.default_generator._key = self._saved
+        return False
+
+
+def _to_arrays(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x._data if isinstance(x, Tensor) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, Tensor),
+    )
+
+
+class StaticFunction:
+    """Stage a tensor function or Layer forward into one XLA computation
+    (ref: jit/dy2static/program_translator.py:397 StaticFunction).
+
+    Parameters/buffers are lifted to inputs on every call (cheap: array
+    handles), so eager updates between calls are honoured without
+    retracing; buffer mutations inside forward (BatchNorm running stats)
+    come back as outputs and are rebound after execution. jax.jit is the
+    compile cache (keyed on input shapes/dtypes — the reference keys its
+    _ExecutorCache on program+scope, base/executor.py:869).
+
+    Training works: when grads are enabled, the staged program is recorded
+    on the eager tape as ONE op whose vjp is the transposed compiled
+    program (jax.vjp of a jitted function runs compiled in both
+    directions) — the analogue of the reference's RunProgramOp wrapping a
+    fwd/bwd partial-program pair (jit/dy2static/partial_program.py).
+    """
+
+    def __init__(self, function, layer=None):
+        self._function = function
+        self._layer = layer
+        if layer is not None:
+            self._params = [p for _, p in layer.named_parameters()]
+            self._buffers = [b for _, b in layer.named_buffers()]
+        else:
+            self._params = []
+            self._buffers = []
+        self._core = None
+        self._out_tree = None
+
+    def _build_core(self):
+        fn = self._function
+        params, buffers = self._params, self._buffers
+        outer = self
+
+        def core(param_arrays, buffer_arrays, key, in_flat, in_meta):
+            """in_flat: flat tensor-slot arrays; in_meta: (treedef, flat
+            template with None at tensor slots, slot indices) — static."""
+            treedef, template, slots = in_meta
+            flat = list(template)
+            for i, a in zip(slots, in_flat):
+                flat[i] = Tensor(a, stop_gradient=True)
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, flat)
+            old_p = _swap_payloads(params, param_arrays)
+            old_b = _swap_payloads(buffers, buffer_arrays)
+            try:
+                with _rng_lift(key) as lift:
+                    with autograd.no_grad():
+                        out = fn(*args, **kwargs)
+                    new_key = lift.final_key()
+                out_flat, out_tree = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor)
+                )
+                outer._out_tree = out_tree
+                out_arrays = [
+                    o._data if isinstance(o, Tensor) else o for o in out_flat
+                ]
+                new_buf = [b._data for b in buffers]
+            finally:
+                _swap_payloads(params, old_p)
+                _swap_payloads(buffers, old_b)
+            return out_arrays, new_buf, new_key
+
+        return jax.jit(core, static_argnames=("in_meta",))
+
+    @staticmethod
+    def _is_data(x):
+        import numpy as np
+
+        return isinstance(x, (Tensor, jax.Array, np.ndarray))
+
+    def _split_inputs(self, args, kwargs):
+        """Split (args, kwargs) into traced data slots and a hashable
+        static template (treedef + non-data leaves)."""
+        flat, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor)
+        )
+        slots = tuple(i for i, x in enumerate(flat) if self._is_data(x))
+        arrays = [
+            flat[i]._data if isinstance(flat[i], Tensor) else flat[i]
+            for i in slots
+        ]
+        template = tuple(
+            None if self._is_data(x) else x for x in flat
+        )
+        return arrays, (treedef, template, slots)
+
+    def __call__(self, *args, **kwargs):
+        if self._core is None:
+            self._core = self._build_core()
+        in_arrays, in_meta = self._split_inputs(args, kwargs)
+        buf_arrays = [b._data for b in self._buffers]
+        key = random_mod.default_generator.split_key()
+        params = self._params
+        n_out = [None]
+
+        train_mode = autograd.is_grad_enabled() and any(
+            not p.stop_gradient for p in params
+        )
+        if train_mode:
+            core = self._core
+            n_p = len(params)
+
+            def impl(*arrays):
+                outs, new_buf, _ = core(
+                    list(arrays[:n_p]), buf_arrays, key,
+                    list(arrays[n_p:]), in_meta,
+                )
+                n_out[0] = len(outs)
+                return tuple(outs) + tuple(new_buf)
+
+            from ..core import dispatch
+
+            flat_all = jax.tree_util.tree_flatten(
+                (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor)
+            )[0]
+            slot_vals = [flat_all[i] for i in in_meta[2]]
+            in_tensors = [
+                v if isinstance(v, Tensor) else Tensor(v, stop_gradient=True)
+                for v in slot_vals
+            ]
+            results = dispatch.call(
+                "jit_program", impl, tuple(params) + tuple(in_tensors), {}
+            )
+            results = (
+                list(results) if isinstance(results, (tuple, list))
+                else [results]
+            )
+            k = n_out[0]
+            out_flat = results[:k]
+            new_buf = results[k:]
+            for b, nb in zip(self._buffers, new_buf):
+                if nb is not None:
+                    b._rebind(nb.detach()._data)
+            return jax.tree_util.tree_unflatten(self._out_tree, out_flat)
+
+        outs, new_buf, _ = self._core(
+            [p._data for p in params], buf_arrays, key, in_arrays, in_meta
+        )
+        for b, a in zip(self._buffers, new_buf):
+            b._rebind(a)
+        out_flat = [
+            Tensor(a, stop_gradient=True) if isinstance(a, jax.Array) else a
+            for a in outs
+        ]
+        return jax.tree_util.tree_unflatten(self._out_tree, out_flat)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Decorator/wrapper staging a function or Layer (ref: jit/api.py:197).
+
+    ``input_spec``/``build_strategy``/``backend`` are accepted for API
+    parity; shapes are taken from the first call (jax.jit caches per
+    shape signature, recompiling per new signature — the bucketing
+    policy replacing the reference's symbolic-shape DimExpr machinery).
+    """
+    def _wrap(obj):
+        if isinstance(obj, Layer):
+            sf = StaticFunction(obj.forward, layer=obj)
+            obj.forward = sf
+            return obj
+        if obj in _NOT_TO_STATIC:
+            return obj
+        return StaticFunction(obj)
+
+    if function is not None:
+        return _wrap(function)
+    return _wrap
+
+
+class TrainStep:
+    """Whole-train-step staging: fwd + bwd + clip + optimizer update in ONE
+    XLA program with donated parameter/optimizer-state buffers.
+
+    The analogue of the reference's Plan/Job executor path
+    (new_executor/standalone_executor.cc:47) composed with its inplace pass:
+    XLA sees the complete step, fuses across the fwd/bwd boundary, and
+    writes parameter updates in place via donation.
+
+        step = paddle.jit.TrainStep(model, loss_fn, optimizer)
+        loss = step(x, y)      # loss_fn(model, x, y) -> scalar loss
+
+    ``loss_fn(model, *args, **kwargs)`` runs the forward and returns the
+    scalar loss; everything it does is staged. The LR schedule and
+    GradScaler found_inf enter as scalar operands (no recompile per step).
+    """
+
+    def __init__(self, model, loss_fn, optimizer, donate=True):
+        self._model = model
+        self._loss_fn = loss_fn
+        self._opt = optimizer
+        self._donate = donate
+        self._params = [
+            p for p in optimizer._parameter_list
+            if getattr(p, "trainable", not p.stop_gradient)
+        ]
+        self._buffers = [b for _, b in model.named_buffers()]
+        self._compiled = None
+        self._live_idx = None  # params that actually received grads
+
+    def _build(self):
+        model, loss_fn, opt = self._model, self._loss_fn, self._opt
+        params, buffers = self._params, self._buffers
+        opt_step_fn = opt._make_step_fn()
+
+        def staged(param_arrays, buffer_arrays, states, lr, t, found_inf,
+                   key, tree_args):
+            old_p = _swap_payloads(params, param_arrays)
+            old_b = _swap_payloads(buffers, buffer_arrays)
+            saved = [(p.grad, p._grad_node, p._out_index, p.stop_gradient)
+                     for p in params]
+            try:
+                for p in params:
+                    p.grad = None
+                    p._grad_node = None
+                    p.stop_gradient = False
+                with _rng_lift(key) as lift:
+                    args, kwargs = tree_args
+                    loss = loss_fn(model, *args, **kwargs)
+                    loss.backward()
+                    new_key = lift.final_key()
+
+                live_idx = [
+                    i for i, p in enumerate(params) if p.grad is not None
+                ]
+                if self._live_idx is None:
+                    self._live_idx = live_idx
+                live = [params[i] for i in live_idx]
+                attrs = tuple(self._attr_for(p) for p in live)
+                new_live, new_states = opt_step_fn(
+                    attrs, lr, t, found_inf,
+                    [p._data for p in live],
+                    [p.grad._data for p in live],
+                    [states[i] for i in live_idx],
+                )
+                new_param_arrays = list(param_arrays)
+                out_states = list(states)
+                for j, i in enumerate(live_idx):
+                    new_param_arrays[i] = new_live[j]
+                    out_states[i] = new_states[j]
+                new_buffer_arrays = [b._data for b in buffers]
+                loss_val = loss._data
+            finally:
+                _swap_payloads(params, [s for s in old_p])
+                _swap_payloads(buffers, old_b)
+                for p, (g, node, oi, sg) in zip(params, saved):
+                    p.grad = g
+                    p._grad_node = node
+                    p._out_index = oi
+                    p.stop_gradient = sg
+            return (new_param_arrays, new_buffer_arrays, out_states,
+                    loss_val, new_key)
+
+        donate = (0, 2) if self._donate else ()
+        return jax.jit(staged, donate_argnums=donate)
+
+    def _attr_for(self, p):
+        """Per-param static attrs, mirroring Optimizer._collect for one
+        param (group lookup preserved)."""
+        from ..optimizer.optimizer import _PAttr, _normalize_weight_decay
+
+        opt = self._opt
+        for group in opt._param_groups:
+            if any(q is p for q in group["params"]):
+                g_kind, g_coeff = opt._group_weight_decay(group)
+                lr_scale = float(group.get("learning_rate", 1.0))
+                break
+        else:
+            group, g_kind, g_coeff, lr_scale = None, None, 0.0, 1.0
+        preg = getattr(p, "regularizer", None)
+        if preg is not None:
+            g_kind, g_coeff = _normalize_weight_decay(preg)
+        decoupled, lr_ratio = opt._param_extras(p, group)
+        return _PAttr(
+            lr_scale=lr_scale
+            * float(getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)),
+            reg_kind=g_kind,
+            reg_coeff=g_coeff,
+            need_clip=getattr(p, "need_clip", True),
+            multi_precision=opt._use_master(p),
+            decoupled_decay=decoupled,
+            lr_ratio=lr_ratio,
+        )
+
+    def __call__(self, *args, **kwargs):
+        opt = self._opt
+        if self._compiled is None:
+            self._compiled = self._build()
+        states = [opt._ensure_state(p) for p in self._params]
+        lr = jnp.float32(opt.get_lr())
+        t = jnp.float32(opt._global_step + 1)
+        found_inf = (
+            opt._found_inf if opt._found_inf is not None
+            else jnp.asarray(False)
+        )
+        key = random_mod.default_generator.split_key()
+        tree_args = (_to_arrays(args), _to_arrays(kwargs))
+        (new_params, new_buffers, new_states, loss_val, _) = self._compiled(
+            [p._data for p in self._params],
+            [b._data for b in self._buffers],
+            states, lr, t, found_inf, key, tree_args,
+        )
+        with autograd.no_grad():
+            for p, a, ns in zip(self._params, new_params, new_states):
+                p._rebind(a)
+                p.grad = None
+                opt._accumulators[id(p)] = ns
+            for b, a in zip(self._buffers, new_buffers):
+                b._rebind(a)
+        opt._global_step += 1
+        return Tensor(loss_val, stop_gradient=True)

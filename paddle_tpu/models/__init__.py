@@ -1,0 +1,5 @@
+"""Flagship model definitions (Llama-family decoder for the BASELINE
+configs; vision models live in paddle_tpu.vision.models)."""
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM"]

@@ -271,11 +271,9 @@ class _InstanceNormBase(Layer):
         self._num_features = num_features
         self._epsilon = epsilon
         self._data_format = data_format
-        if weight_attr is False or bias_attr is False:
+        if weight_attr is False:
             self.scale = None
-            self.bias = None
             self.add_parameter("scale", None)
-            self.add_parameter("bias", None)
         else:
             attr = ParamAttr._to_attr(weight_attr)
             if attr.initializer is None:
@@ -283,6 +281,10 @@ class _InstanceNormBase(Layer):
             self.scale = self.create_parameter(
                 shape=[num_features], attr=attr
             )
+        if bias_attr is False:
+            self.bias = None
+            self.add_parameter("bias", None)
+        else:
             battr = ParamAttr._to_attr(bias_attr)
             if battr.initializer is None:
                 battr.initializer = I.Constant(0.0)
@@ -352,6 +354,8 @@ class SpectralNorm(Layer):
     def forward(self, weight):
         import jax.numpy as jnp
 
+        # Power iteration on raw arrays (no_grad, like the reference's
+        # stop-gradient u/v buffers)...
         w = weight._data
         if self._dim != 0:
             w = jnp.moveaxis(w, self._dim, 0)
@@ -365,6 +369,21 @@ class SpectralNorm(Layer):
             u = u / (jnp.linalg.norm(u) + self._epsilon)
         self.weight_u._rebind(u)
         self.weight_v._rebind(v)
-        sigma = u @ mat @ v
-        out = weight / Tensor(sigma, stop_gradient=True)
-        return out
+        # ...but sigma = u^T W v through tensor ops, so the backward gets
+        # the full d(W/sigma)/dW including sigma's dependence on W
+        # (ref: phi spectral_norm_grad_kernel).
+        perm = None
+        w_t = weight
+        if self._dim != 0:
+            perm = list(range(weight.ndim))
+            perm.insert(0, perm.pop(self._dim))
+            from ... import ops as F
+
+            w_t = F.transpose(weight, perm)
+        from ... import ops as F
+
+        mat_t = F.reshape(w_t, [h, -1])
+        u_t = Tensor(u.reshape(1, -1), stop_gradient=True)
+        v_t = Tensor(v.reshape(-1, 1), stop_gradient=True)
+        sigma = F.reshape(F.matmul(F.matmul(u_t, mat_t), v_t), [])
+        return weight / sigma

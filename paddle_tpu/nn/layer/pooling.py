@@ -34,6 +34,10 @@ class MaxPool1D(_PoolNd):
                  return_mask=False, ceil_mode=False, name=None):
         super().__init__(kernel_size, stride, padding, ceil_mode,
                          data_format="NCL")
+        if return_mask:
+            raise NotImplementedError(
+                "return_mask is only implemented for MaxPool2D"
+            )
 
     def forward(self, x):
         return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
@@ -46,8 +50,14 @@ class MaxPool2D(_PoolNd):
                  name=None):
         super().__init__(kernel_size, stride, padding, ceil_mode,
                          data_format=data_format)
+        self.return_mask = return_mask
 
     def forward(self, x):
+        if self.return_mask:
+            return F.max_pool2d_with_index(
+                x, self.kernel_size, self.stride, self.padding,
+                self.ceil_mode, self.data_format,
+            )
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
                             self.ceil_mode, self.data_format)
 
@@ -58,6 +68,10 @@ class MaxPool3D(_PoolNd):
                  name=None):
         super().__init__(kernel_size, stride, padding, ceil_mode,
                          data_format=data_format)
+        if return_mask:
+            raise NotImplementedError(
+                "return_mask is only implemented for MaxPool2D"
+            )
 
     def forward(self, x):
         return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
@@ -127,6 +141,10 @@ class AdaptiveAvgPool2D(Layer):
 class AdaptiveMaxPool2D(Layer):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__()
+        if return_mask:
+            raise NotImplementedError(
+                "AdaptiveMaxPool2D return_mask is not implemented"
+            )
         self._output_size = output_size
 
     def forward(self, x):

@@ -121,8 +121,11 @@ def gen_one(entry) -> tuple[str, str, list[tuple[str, str]]]:
             coerce_lines.append(f"    {p['name']} = {fn}({p['name']})")
         attr_items.append(f"'{p['name']}': {p['name']}")
     if rng:
+        # rng: true -> attr 'key'; rng: <name> -> custom kwarg (used when
+        # the op already has a tensor arg named `key`, e.g. attention)
+        rng_name = rng if isinstance(rng, str) else "key"
         coerce_lines.append("    _key = _split_key()")
-        attr_items.append("'key': _key")
+        attr_items.append(f"'{rng_name}': _key")
 
     attrs = "{" + ", ".join(attr_items) + "}"
     targs = ", ".join(tensor_args)

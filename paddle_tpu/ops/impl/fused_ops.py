@@ -1,0 +1,118 @@
+"""Fused transformer building-block ops (math reference implementations).
+
+ref: python/paddle/incubate/nn/functional/{fused_rotary_position_embedding,
+swiglu, fused_rms_norm}.py — the exact op set SURVEY §2.11 marks for the TPU
+build. These are the XLA-fused math paths; kernels/pallas/* provides TPU
+Pallas overrides behind FLAGS_use_pallas_kernels where XLA fusion is not
+enough.
+
+Layouts follow the reference: q/k/v are [batch, seq, heads, head_dim].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _build_rope_cache(seq_len, head_dim, base, dtype, position_ids=None):
+    inv_freq = 1.0 / (
+        base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    if position_ids is None:
+        t = jnp.arange(seq_len, dtype=jnp.float32)[None, :]
+    else:
+        t = position_ids.astype(jnp.float32)
+    freqs = jnp.einsum("bs,d->bsd", t, inv_freq)  # [b, s, hd/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def _apply_rope(x, cos, sin, use_neox):
+    """x: [b, s, h, d]; cos/sin: [b or 1, s, d/2]."""
+    xf = x.astype(jnp.float32)
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    if use_neox:
+        # neox style: rotate halves [x1, x2] -> [x1*c - x2*s, x2*c + x1*s]
+        d2 = x.shape[-1] // 2
+        x1, x2 = xf[..., :d2], xf[..., d2:]
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    else:
+        # GPT-J interleaved pairs
+        x1 = xf[..., 0::2]
+        x2 = xf[..., 1::2]
+        r1 = x1 * c - x2 * s
+        r2 = x2 * c + x1 * s
+        out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def fused_rotary_position_embedding(
+    q, k=None, v=None, sin=None, cos=None, position_ids=None, *,
+    use_neox_rotary_style=True, rotary_emb_base=10000.0,
+):
+    """ref: incubate/nn/functional/fused_rotary_position_embedding.py —
+    applies RoPE to q (and k, v when given). sin/cos may be precomputed
+    ([1, s, 1, d] or [s, d/2]-broadcastable); otherwise built from the base.
+    Returns the same number of tensors as were passed (None for absent)."""
+    b, s, h, d = q.shape
+    if cos is None or sin is None:
+        cos_h, sin_h = _build_rope_cache(
+            s, d, rotary_emb_base, q.dtype, position_ids
+        )
+    else:
+        cos_h = jnp.asarray(cos, jnp.float32)
+        sin_h = jnp.asarray(sin, jnp.float32)
+        # accept [1, s, 1, d] (paddle) by squeezing the head axis and
+        # halving duplicated last dim
+        if cos_h.ndim == 4:
+            cos_h = cos_h[:, :, 0, :]
+            sin_h = sin_h[:, :, 0, :]
+        if cos_h.shape[-1] == d:
+            cos_h = cos_h[..., : d // 2]
+            sin_h = sin_h[..., : d // 2]
+        if cos_h.ndim == 2:
+            cos_h = cos_h[None]
+            sin_h = sin_h[None]
+
+    outs = [_apply_rope(q, cos_h, sin_h, use_neox_rotary_style)]
+    for t in (k, v):
+        outs.append(
+            _apply_rope(t, cos_h, sin_h, use_neox_rotary_style)
+            if t is not None
+            else None
+        )
+    return tuple(outs)
+
+
+def rope_qk(q, k, *, base=10000.0, use_neox_rotary_style=True):
+    """Fast path for the common q,k case (single op on the tape)."""
+    out = fused_rotary_position_embedding(
+        q, k, None, use_neox_rotary_style=use_neox_rotary_style,
+        rotary_emb_base=base,
+    )
+    return out[0], out[1]
+
+
+def fused_linear(x, weight, bias=None, *, transpose_weight=False):
+    """ref: incubate/nn/functional/fused_matmul_bias.py."""
+    w = weight.T if transpose_weight else weight
+    out = jnp.matmul(x, w)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_bias_act(x, bias=None, *, act_method="gelu"):
+    """ref: incubate/nn/functional/fused_bias_act.py."""
+    if bias is not None:
+        x = x + bias
+    if act_method == "gelu":
+        return jax.nn.gelu(x)
+    if act_method == "relu":
+        return jax.nn.relu(x)
+    if act_method in ("silu", "swish"):
+        return jax.nn.silu(x)
+    if act_method == "swiglu":
+        a, b = jnp.split(x, 2, axis=-1)
+        return jax.nn.silu(a) * b
+    raise ValueError(f"unknown act_method {act_method!r}")

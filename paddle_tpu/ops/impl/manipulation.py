@@ -329,6 +329,8 @@ def repeat_interleave(x, *, repeats, axis=None):
     if axis is None:
         x = x.reshape(-1)
         axis = 0
+    if isinstance(repeats, (list, tuple)):
+        repeats = repeats[0] if len(repeats) == 1 else jnp.asarray(repeats)
     return jnp.repeat(x, repeats, axis=int(axis))
 
 

@@ -796,11 +796,14 @@ def grid_sample(x, grid, *, mode="bilinear", padding_mode="zeros", align_corners
 
 # ---- attention -----------------------------------------------------------
 def scaled_dot_product_attention(
-    query, key, value, attn_mask=None, *, dropout_p=0.0, is_causal=False, scale=None
+    query, key, value, attn_mask=None, *, key_rng=None, dropout_p=0.0,
+    is_causal=False, scale=None
 ):
     """Math fallback (ref: nn/functional/flash_attention.py:976). Layout:
     [batch, seq, heads, head_dim] like the reference; the Pallas flash
-    kernel (kernels/pallas/flash_attention.py) overrides this on TPU."""
+    kernel (kernels/pallas/flash_attention.py) overrides this on TPU.
+    Attention dropout is applied to the probabilities when dropout_p > 0
+    (key_rng is plumbed by the generated wrapper)."""
     q = jnp.swapaxes(query, 1, 2).astype(jnp.float32)  # [b, h, s, d]
     k = jnp.swapaxes(key, 1, 2).astype(jnp.float32)
     v = jnp.swapaxes(value, 1, 2).astype(jnp.float32)
@@ -817,6 +820,9 @@ def scaled_dot_product_attention(
         else:
             scores = scores + attn_mask.astype(scores.dtype)
     probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_p > 0.0 and key_rng is not None:
+        keep = jax.random.bernoulli(key_rng, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     return jnp.swapaxes(out, 1, 2).astype(query.dtype)
 
@@ -868,3 +874,52 @@ def upsample(x, *, size=None, scale_factor=None, mode="nearest",
         x, size=size, scale_factor=scale_factor, mode=mode,
         align_corners=align_corners, data_format=data_format,
     )
+
+
+def max_pool2d_with_index(x, *, kernel_size, stride=None, padding=0,
+                          ceil_mode=False, data_format="NCHW"):
+    """(out, mask) where mask holds the flattened input H*W index of each
+    window max (ref: phi MaxPoolWithIndexInferMeta; python
+    nn/functional/pooling.py max_pool2d return_mask=True).
+
+    Implemented with conv_general_dilated_patches + argmax over the window
+    axis — one fused XLA computation, no select_and_scatter."""
+    if data_format != "NCHW":
+        raise ValueError("max_pool2d_with_index requires NCHW")
+    k = _normalize_tuple(kernel_size, 2)
+    s = _normalize_tuple(stride if stride is not None else kernel_size, 2)
+    pad = _conv_padding(padding, 2, s, k, (1, 1))
+    if isinstance(pad, str):
+        raise ValueError("string padding unsupported for return_mask")
+    n, c, h, w = x.shape
+    neg = jnp.asarray(-jnp.inf, x.dtype) if jnp.issubdtype(
+        x.dtype, jnp.floating
+    ) else jnp.iinfo(x.dtype).min
+    # patches: [N, C*kh*kw, OH, OW] (channel-major over C then window)
+    patches = jax.lax.conv_general_dilated_patches(
+        jnp.where(jnp.isfinite(x.astype(jnp.float32)), x, x),
+        filter_shape=k, window_strides=s, padding=pad,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        precision=None,
+    )
+    oh, ow = patches.shape[2], patches.shape[3]
+    patches = patches.reshape(n, c, k[0] * k[1], oh, ow)
+    # padded positions must lose the argmax: rebuild the same patches from
+    # a validity mask
+    valid = jax.lax.conv_general_dilated_patches(
+        jnp.ones((n, c, h, w), jnp.float32), filter_shape=k,
+        window_strides=s, padding=pad,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    ).reshape(n, c, k[0] * k[1], oh, ow)
+    scored = jnp.where(valid > 0, patches.astype(jnp.float32), -jnp.inf)
+    local = jnp.argmax(scored, axis=2)  # [N, C, OH, OW]
+    out = jnp.max(scored, axis=2).astype(x.dtype)
+    # local window idx -> global flat H*W idx
+    ky = local // k[1]
+    kx = local % k[1]
+    oy = jnp.arange(oh).reshape(1, 1, oh, 1)
+    ox = jnp.arange(ow).reshape(1, 1, 1, ow)
+    iy = oy * s[0] - pad[0][0] + ky
+    ix = ox * s[1] - pad[1][0] + kx
+    mask = (iy * w + ix).astype(jnp.int32)
+    return out, mask

@@ -41,8 +41,11 @@ class AdamW(Adam):
         # coupled; the group coefficient is consumed in _param_extras.
         return None, 0.0
 
-    def _param_extras(self, p):
+    def _param_extras(self, p, group=None):
         decay = self._coeff
+        if group is not None and group.get("weight_decay") is not None:
+            gwd = group["weight_decay"]
+            decay = float(getattr(gwd, "coeff", gwd))
         if self._apply_decay_param_fun is not None and not (
             self._apply_decay_param_fun(p.name)
         ):

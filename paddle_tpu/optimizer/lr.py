@@ -266,8 +266,10 @@ class MultiplicativeDecay(LRScheduler):
         super().__init__(learning_rate, last_epoch, verbose)
 
     def step(self, epoch=None):
-        if epoch is None:
-            # incremental O(1) path for sequential stepping
+        # O(1) incremental path only once last_lr provably corresponds to
+        # last_epoch (i.e. after one full get_lr); a construction-time
+        # last_epoch jump or explicit epoch uses the full product.
+        if epoch is None and getattr(self, "_incremental_ok", False):
             self.last_epoch += 1
             if self.last_epoch > 0:
                 self.last_lr = self.last_lr * self.lr_lambda(self.last_epoch)
@@ -278,6 +280,7 @@ class MultiplicativeDecay(LRScheduler):
                 )
             return
         super().step(epoch)
+        self._incremental_ok = True
 
     def get_lr(self):
         cur = self.base_lr

@@ -195,7 +195,7 @@ class Optimizer:
                 preg = getattr(p, "regularizer", None)
                 if preg is not None:
                     kind, coeff = _normalize_weight_decay(preg)
-                decoupled, lr_ratio = self._param_extras(p)
+                decoupled, lr_ratio = self._param_extras(p, group)
                 attr = _PAttr(
                     lr_scale=lr_scale
                     * float(
@@ -212,7 +212,7 @@ class Optimizer:
                 out.append((p, g_arr, attr))
         return out
 
-    def _param_extras(self, p):
+    def _param_extras(self, p, group=None):
         """Hook for subclasses: (decoupled_decay_coeff, lr_ratio) baked into
         the per-param static attrs (AdamW overrides)."""
         return 0.0, 1.0
