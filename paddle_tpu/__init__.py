@@ -51,6 +51,7 @@ from .autograd import grad  # noqa: E402  (needs patched Tensor)
 from . import amp  # noqa: E402
 from . import autograd  # noqa: E402
 from . import framework  # noqa: E402
+from . import distributed  # noqa: E402
 from . import jit  # noqa: E402
 from . import nn  # noqa: E402
 from . import optimizer  # noqa: E402

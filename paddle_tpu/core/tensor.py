@@ -87,11 +87,13 @@ class Tensor:
     @property
     def shape(self):
         if self._dist_meta is not None:
-            return list(self._dist_meta.global_shape)
+            return list(self._dist_meta.global_shape_of(self._data))
         return list(self._data.shape)
 
     @property
     def ndim(self):
+        if self._dist_meta is not None:
+            return len(self._dist_meta.global_shape_of(self._data))
         return self._data.ndim
 
     dim = ndim
@@ -143,12 +145,17 @@ class Tensor:
         return self._data
 
     def item(self, *args):
+        data = self._local_or_global_data()
         if args:
-            return self._data[args].item() if len(args) > 1 else np.asarray(self._data).flat[args[0]].item()
-        return self._data.item()
+            return (
+                data[args].item()
+                if len(args) > 1
+                else np.asarray(data).flat[args[0]].item()
+            )
+        return data.item()
 
     def tolist(self):
-        return np.asarray(self._data).tolist()
+        return np.asarray(self._local_or_global_data()).tolist()
 
     def __array__(self, dtype=None):
         a = self.numpy()

@@ -1,0 +1,56 @@
+"""paddle.distributed analogue (ref: python/paddle/distributed/__init__.py).
+
+Wires the DistTensor dispatch hook into core.dispatch at import time (the
+analogue of the generated dist branch in every ad_func).
+"""
+from ..core import dispatch as _dispatch
+from .communication import (
+    Group,
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    get_group,
+    new_group,
+    reduce,
+    reduce_scatter,
+    scatter,
+)
+from .dispatch_hook import dist_dispatch as _dist_dispatch
+from .dist_tensor import (
+    DistMeta,
+    dtensor_from_local,
+    dtensor_to_local,
+    reshard,
+    shard_tensor,
+    to_global_array,
+    unshard_dtensor,
+)
+from .parallel import (
+    DataParallel,
+    ParallelEnv,
+    default_mesh,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    shard_layer,
+    shard_optimizer,
+)
+from .placement import Partial, Placement, Replicate, Shard
+from .process_mesh import ProcessMesh
+
+_dispatch.set_dist_hook(_dist_dispatch)
+
+__all__ = [
+    "ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "dtensor_from_local", "dtensor_to_local",
+    "unshard_dtensor", "to_global_array", "DistMeta",
+    "Group", "ReduceOp", "new_group", "get_group", "destroy_process_group",
+    "all_reduce", "all_gather", "all_to_all", "broadcast", "reduce",
+    "reduce_scatter", "scatter", "barrier",
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+    "DataParallel", "shard_layer", "shard_optimizer", "default_mesh",
+]

@@ -1,0 +1,264 @@
+"""Eager collective API + process groups.
+
+ref: python/paddle/distributed/communication/{all_reduce,all_gather,
+all_to_all,broadcast,reduce_scatter,scatter,reduce,group}.py and the
+ProcessGroup stack (phi/core/distributed/collective/process_group.h:48,
+fluid/distributed/collective/process_group_nccl.h:37).
+
+TPU-native model (SURVEY §2.6 "TPU equivalent" row): there are no per-rank
+processes issuing NCCL calls — collectives are array operations on global
+arrays whose rank axis is the leading dimension, stacked over a Group's
+1-d mesh. Each function takes/returns the stacked form (`x[rank, ...]`):
+what rank r "holds" is `x[r]`. The ops run through the normal dispatcher,
+so they are differentiable and GSPMD lowers them to real ICI collectives
+when the rank axis is device-sharded. Under multi-controller
+(jax.distributed) the same global-array code spans hosts.
+
+The reference's stream/`sync_op` knobs collapse: XLA schedules collectives
+(no user-visible comm streams); `sync_op=False` returns immediately anyway
+because jax dispatch is async.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dist_tensor import dtensor_from_local, shard_tensor
+from .placement import Replicate, Shard
+from .process_mesh import ProcessMesh
+
+__all__ = [
+    "Group", "new_group", "get_group", "destroy_process_group",
+    "all_reduce", "all_gather", "all_to_all", "broadcast", "reduce",
+    "reduce_scatter", "scatter", "barrier", "ReduceOp",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    AVG = "avg"
+    PROD = "prod"
+
+
+class Group:
+    """A collective group = an ordered list of global ranks backed by a
+    1-d mesh over those devices (ref communication/group.py)."""
+
+    _next_id = 0
+
+    def __init__(self, ranks, name=None):
+        self.ranks = list(ranks)
+        self.nranks = len(self.ranks)
+        self.id = Group._next_id
+        Group._next_id += 1
+        self.name = name or f"group_{self.id}"
+        self.process_mesh = ProcessMesh(self.ranks, ["rank"])
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+_default_group = None
+_groups = {}
+
+
+def _world():
+    import jax
+
+    global _default_group
+    if _default_group is None:
+        _default_group = Group(list(range(len(jax.devices()))), "default")
+        _groups[_default_group.id] = _default_group
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    g = Group(ranks if ranks is not None else _world().ranks)
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid, _world())
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+    else:
+        _groups.pop(group.id, None)
+
+
+def _stacked(x, group):
+    """Coerce input to the stacked [nranks, ...] DistTensor over the
+    group's rank mesh."""
+    g = group or _world()
+    if not isinstance(x, Tensor):
+        x = Tensor(x)
+    if x._dist_meta is None:
+        if x.shape[0] != g.nranks:
+            raise ValueError(
+                f"stacked collective input needs leading dim {g.nranks}, "
+                f"got shape {x.shape} (wrap per-rank values with "
+                "dtensor_from_local or stack them)"
+            )
+        x = shard_tensor(x, g.process_mesh, [Shard(0)])
+    return x, g
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Every rank ends with the elementwise reduction (ref
+    communication/all_reduce.py). Stacked form: out[r] = reduce_r' x[r']."""
+    from .. import ops as F
+
+    x, g = _stacked(tensor, group)
+    fns = {"sum": F.sum, "avg": F.mean, "max": F.max, "min": F.min,
+           "prod": F.prod}
+    red = fns[op](x, axis=0, keepdim=True)
+    out = F.tile(red, [g.nranks] + [1] * (x.ndim - 1))
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out._data, dist_meta=out._dist_meta)
+        tensor._grad_node = out._grad_node
+        tensor._out_index = out._out_index
+        tensor.stop_gradient = out.stop_gradient
+    return out
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True):
+    """out[r] = concat(x[0], ..., x[n-1]) for every r (ref
+    communication/all_gather.py). Returns the stacked gathered tensor;
+    when called with (tensor_list, tensor) fills the list with per-rank
+    views for API parity."""
+    from .. import ops as F
+
+    if tensor is None:
+        x, g = _stacked(tensor_or_list, group)
+        out_list = None
+    else:
+        out_list, (x, g) = tensor_or_list, _stacked(tensor, group)
+    gathered = F.reshape(x, [1, g.nranks] + list(x.shape[1:]))
+    out = F.tile(gathered, [g.nranks] + [1] * (x.ndim))
+    if out_list is not None:
+        for r in range(g.nranks):
+            out_list.append(F.getitem(x, (r,)))
+        return out_list
+    return out
+
+
+def all_to_all(out_tensor_list, in_tensor_list=None, group=None,
+               sync_op=True):
+    """out[r][j] = in[j][r] (ref communication/all_to_all.py). Stacked
+    form: x[r, j, ...] -> y[r, j, ...] = x[j, r, ...]."""
+    from .. import ops as F
+
+    if in_tensor_list is None:
+        x, g = _stacked(out_tensor_list, group)
+        if x.shape[1] != g.nranks:
+            raise ValueError(
+                f"stacked all_to_all needs shape [n, n, ...]; got {x.shape}"
+            )
+        return F.transpose(
+            x, [1, 0] + list(range(2, x.ndim))
+        )
+    # list API: in_tensor_list has nranks entries per rank — single-
+    # controller stacked emulation
+    g = group or _world()
+    stacked = F.stack(in_tensor_list, axis=0)
+    out = F.transpose(stacked, [1, 0] + list(range(2, stacked.ndim)))
+    for r in range(g.nranks):
+        out_tensor_list.append(F.getitem(out, (r,)))
+    return out_tensor_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """out[r] = x[src_group_rank] (ref communication/broadcast.py)."""
+    from .. import ops as F
+
+    x, g = _stacked(tensor, group)
+    src_rank = g.get_group_rank(src) if src in g.ranks else src
+    piece = F.getitem(x, (slice(src_rank, src_rank + 1),))
+    out = F.tile(piece, [g.nranks] + [1] * (x.ndim - 1))
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out._data, dist_meta=out._dist_meta)
+        tensor._grad_node = out._grad_node
+        tensor._out_index = out._out_index
+        tensor.stop_gradient = out.stop_gradient
+    return out
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Only dst ends with the reduction; others keep their input (ref
+    communication/reduce.py)."""
+    from .. import ops as F
+
+    x, g = _stacked(tensor, group)
+    fns = {"sum": F.sum, "avg": F.mean, "max": F.max, "min": F.min,
+           "prod": F.prod}
+    red = fns[op](x, axis=0, keepdim=True)
+    dst_rank = g.get_group_rank(dst) if dst in g.ranks else dst
+    mask_np = np.zeros((g.nranks,) + (1,) * (x.ndim - 1), np.float32)
+    mask_np[dst_rank] = 1.0
+    mask = F.cast(Tensor(mask_np), x.dtype.name)
+    out = x * (1 - mask) + F.tile(red, [g.nranks] + [1] * (x.ndim - 1)) * mask
+    return out
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """Rank r gets the r-th chunk of the reduction (ref
+    communication/reduce_scatter.py). Stacked x[r, ...] with first tensor
+    dim divisible by nranks -> out[r] = chunk_r(reduce(x)). With the list
+    API (tensor=receive buffer, tensor_list=inputs), the inputs are
+    stacked and the result written into the buffer."""
+    from .. import ops as F
+
+    if tensor_list is not None:
+        x, g = _stacked(F.stack(list(tensor_list), axis=0), group)
+    else:
+        x, g = _stacked(tensor, group)
+    fns = {"sum": F.sum, "avg": F.mean, "max": F.max, "min": F.min,
+           "prod": F.prod}
+    red = fns[op](x, axis=0)  # [chunkdim, ...]
+    out = F.reshape(
+        red, [g.nranks, red.shape[0] // g.nranks] + list(red.shape[1:])
+    )
+    if tensor_list is not None and isinstance(tensor, Tensor):
+        tensor._rebind(out._data, dist_meta=out._dist_meta)
+    return out
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Rank r gets chunk r of src's value (ref communication/scatter.py).
+    List API: tensor_list holds src's per-rank chunks."""
+    from .. import ops as F
+
+    if tensor_list is not None:
+        g = group or _world()
+        out = F.stack(list(tensor_list), axis=0)
+        if isinstance(tensor, Tensor):
+            tensor._rebind(out._data, dist_meta=out._dist_meta)
+        return out
+    x, g = _stacked(tensor, group)
+    src_rank = g.get_group_rank(src) if src in g.ranks else src
+    piece = F.getitem(x, (src_rank,))
+    return F.reshape(
+        piece, [g.nranks, piece.shape[0] // g.nranks] + list(piece.shape[1:])
+    )
+
+
+def barrier(group=None):
+    """Device sync (XLA has no cross-op barrier need; block on a token)."""
+    import jax
+
+    jax.block_until_ready(jax.numpy.zeros(()))

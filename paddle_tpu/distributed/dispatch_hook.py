@@ -1,0 +1,116 @@
+"""DistTensor branch of eager op dispatch.
+
+ref: the generated dist branch in every phi API (dist_api_gen.py:319
+ReshardApiInputToKernelInput → InferSpmd → local kernel → wrap output).
+TPU-first collapse: payloads are global sharded arrays, so the "local
+kernel on the shard + collectives" IS what XLA emits for the regular op —
+the hook only (1) materializes Partial inputs through tape-recorded
+reduction ops (so gradients flow), (2) strips metas so the core dispatcher
+records the op, and (3) re-attaches metas inferred from each output's
+propagated sharding (GSPMD plays the InferSpmd role).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding
+
+from ..core.tensor import Tensor
+from .dist_tensor import DistMeta, _materialize
+from .placement import Replicate, Shard
+
+_REDUCE_OPS = {"sum": "sum", "avg": "mean", "max": "max", "min": "min"}
+
+
+def _materialize_via_tape(x: Tensor) -> Tensor:
+    """Fold partial lead dims with ops-api reductions so the reduction is
+    recorded on the tape (gradient flows to the partial input)."""
+    from .. import ops as F
+
+    meta = x._dist_meta
+    saved = meta
+    x._dist_meta = None
+    try:
+        out = x
+        # reduce lead axes back-to-front with kind i applied to lead axis
+        # i — the same canonical order as dist_tensor._materialize, so the
+        # two paths agree even for non-commuting mixed kinds
+        n = len(meta.partial_axes)
+        for j, (_, kind) in enumerate(reversed(meta.partial_axes)):
+            fn = getattr(F, _REDUCE_OPS[kind])
+            out = fn(out, axis=n - 1 - j)
+    finally:
+        x._dist_meta = saved
+    out._dist_meta = DistMeta(
+        meta.mesh,
+        [Replicate() if p.is_partial() else p for p in meta.placements],
+    )
+    return out
+
+
+def infer_meta_from_array(arr, mesh) -> DistMeta:
+    """Sharding -> placements (the reverse of dist_tensor._sharding)."""
+    placements = [Replicate()] * mesh.ndim
+    sh = getattr(arr, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        names = mesh.dim_names
+        try:
+            spec = sh.spec
+        except Exception:
+            spec = ()
+        for d, entry in enumerate(spec):
+            if entry is None:
+                continue
+            entry_names = entry if isinstance(entry, tuple) else (entry,)
+            for nm in entry_names:
+                if nm in names:
+                    placements[names.index(nm)] = Shard(d)
+    return DistMeta(mesh, placements)
+
+
+def dist_dispatch(op_name, impl, args, attrs):
+    from ..core import dispatch
+
+    flat, treedef = dispatch._tree_flatten_tensors(args)
+    mesh = None
+    for x in flat:
+        if isinstance(x, Tensor) and x._dist_meta is not None:
+            mesh = x._dist_meta.mesh
+            break
+
+    # 1) materialize Partial inputs (tape-recorded)
+    flat = [
+        _materialize_via_tape(x)
+        if (
+            isinstance(x, Tensor)
+            and x._dist_meta is not None
+            and x._dist_meta.partial_axes
+        )
+        else x
+        for x in flat
+    ]
+
+    # 2) strip metas in place (originals keep their tape identity so
+    #    backward deposits grads on the user's tensors), run the op
+    dist_inputs = [
+        x for x in flat
+        if isinstance(x, Tensor) and x._dist_meta is not None
+    ]
+    saved = [(x, x._dist_meta) for x in dist_inputs]
+    for x, _ in saved:
+        x._dist_meta = None
+    try:
+        rebuilt = jax.tree_util.tree_unflatten(treedef, flat)
+        out = dispatch.call(op_name, impl, rebuilt, attrs)
+    finally:
+        for x, m in saved:
+            x._dist_meta = m
+
+    # 3) wrap outputs
+    def _wrap(o):
+        if isinstance(o, Tensor):
+            o._dist_meta = infer_meta_from_array(o._data, mesh)
+        return o
+
+    return jax.tree_util.tree_map(
+        _wrap, out, is_leaf=lambda v: isinstance(v, Tensor)
+    )

@@ -1,0 +1,283 @@
+"""DistTensor core: shard_tensor / reshard / dtensor_from_local.
+
+ref: phi/core/distributed/auto_parallel/dist_tensor.h:39 (DistTensor),
+python/paddle/distributed/auto_parallel/api.py:220 (shard_tensor), :733
+(reshard), :647 (dtensor_from_local), :2947 (unshard_dtensor), and the
+reshard function registry (auto_parallel/reshard/*.cc).
+
+TPU-first representation: the payload of a DistTensor is a GLOBAL
+jax.Array carrying a NamedSharding — XLA/GSPMD is the reshard engine and
+the SPMD-rule table (the reference needs 15 hand-written reshard functions
++ ~50 per-op SPMD rules; here device_put(new_sharding) and sharding
+propagation do both). `Partial` placements are encoded as one extra
+leading "unreduced" dimension per partial mesh axis, sharded along that
+axis; materializing the true value is a sum over those leading dims, which
+XLA lowers to the all-reduce / reduce-scatter the reference's p_to_r /
+p_to_s functions perform explicitly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from .placement import Partial, Placement, Replicate, Shard
+from .process_mesh import ProcessMesh
+
+__all__ = [
+    "DistMeta", "shard_tensor", "reshard", "dtensor_from_local",
+    "dtensor_to_local", "unshard_dtensor", "to_global_array",
+]
+
+
+class DistMeta:
+    """(mesh, placements) pair carried on Tensor._dist_meta."""
+
+    __slots__ = ("mesh", "placements")
+
+    def __init__(self, mesh: ProcessMesh, placements):
+        if len(placements) != mesh.ndim:
+            raise ValueError(
+                f"need one placement per mesh dim: got {len(placements)} "
+                f"for mesh of rank {mesh.ndim}"
+            )
+        for p in placements:
+            if not isinstance(p, Placement):
+                raise TypeError(f"bad placement {p!r}")
+        self.mesh = mesh
+        self.placements = list(placements)
+
+    @property
+    def partial_axes(self):
+        """[(mesh_dim_idx, reduce_type)] in mesh order."""
+        return [
+            (i, p.reduce_type)
+            for i, p in enumerate(self.placements)
+            if p.is_partial()
+        ]
+
+    def global_shape_of(self, payload):
+        """Logical shape = payload minus the partial lead dims."""
+        return tuple(payload.shape[len(self.partial_axes):])
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, DistMeta)
+            and self.mesh == other.mesh
+            and self.placements == other.placements
+        )
+
+    def __repr__(self):
+        return f"DistMeta({self.mesh}, {self.placements})"
+
+
+def _sharding(meta: DistMeta, tensor_rank: int):
+    """placements -> NamedSharding over the PAYLOAD (leading partial dims
+    first — each sharded along its own mesh axis — then tensor dims)."""
+    names = meta.mesh.dim_names
+    entries = [names[i] for i, _ in meta.partial_axes]
+    tensor_map = {}
+    for i, p in enumerate(meta.placements):
+        if p.is_shard():
+            tensor_map.setdefault(p.get_dim(), []).append(names[i])
+    for d in range(tensor_rank):
+        axes = tensor_map.get(d, [])
+        if len(axes) == 1:
+            entries.append(axes[0])
+        elif len(axes) > 1:
+            entries.append(tuple(axes))
+        else:
+            entries.append(None)
+    return NamedSharding(meta.mesh.jax_mesh(), PartitionSpec(*entries))
+
+
+def payload_rank(meta: DistMeta, payload) -> int:
+    """Rank of the logical tensor (payload minus partial lead dims)."""
+    return payload.ndim - len(meta.partial_axes)
+
+
+def _check_divisible(shape, meta: DistMeta):
+    for i, p in enumerate(meta.placements):
+        if p.is_shard():
+            d = p.get_dim()
+            size = meta.mesh.shape[i]
+            if shape[d] % size != 0:
+                raise ValueError(
+                    f"tensor dim {d} (size {shape[d]}) not divisible by "
+                    f"mesh dim {i} (size {size})"
+                )
+
+
+def shard_tensor(x, mesh: ProcessMesh, placements, stop_gradient=None):
+    """Attach mesh+placements and lay the data out (ref api.py:220)."""
+    if not isinstance(x, Tensor):
+        x = Tensor(x)
+    meta = DistMeta(mesh, placements)
+    if meta.partial_axes:
+        raise ValueError(
+            "shard_tensor cannot create Partial placements; use reshard"
+        )
+    arr = x._data
+    _check_divisible(arr.shape, meta)
+    sharding = _sharding(meta, arr.ndim)
+    sg = x.stop_gradient if stop_gradient is None else stop_gradient
+
+    from ..core import autograd, dispatch
+
+    if not x.stop_gradient and autograd.is_grad_enabled():
+        # record on the tape (identity-with-layout; vjp is identity) so
+        # gradients flow back to the source tensor
+        out = dispatch.call(
+            "shard_tensor", lambda a: jax.device_put(a, sharding), (x,), {}
+        )
+        out.stop_gradient = sg
+    else:
+        out = Tensor(jax.device_put(arr, sharding), stop_gradient=sg)
+    out._dist_meta = meta
+    out.name = x.name
+    return out
+
+
+def dtensor_from_local(local, mesh: ProcessMesh, placements):
+    """Build a DistTensor from this-rank local shards (ref api.py:647).
+
+    Single-controller form: `local` carries ALL ranks' shards stacked
+    along each sharded tensor dim (i.e. it is already the global value);
+    under multi-controller jax it is the per-host shard and
+    jax.make_array_from_single_device_arrays assembles the global array.
+    """
+    if not isinstance(local, Tensor):
+        local = Tensor(local)
+    meta = DistMeta(mesh, placements)
+    arr = local._data
+    if meta.partial_axes:
+        # caller passes the stacked unreduced values: leading dims already
+        # present, one per partial axis (size = mesh dim size)
+        expect = [mesh.shape[i] for i, _ in meta.partial_axes]
+        got = list(arr.shape[: len(expect)])
+        if got != expect:
+            raise ValueError(
+                f"partial dtensor_from_local expects leading dims {expect},"
+                f" got {got}"
+            )
+    sharded = jax.device_put(arr, _sharding(meta, payload_rank(meta, arr)))
+    out = Tensor(sharded, stop_gradient=local.stop_gradient)
+    out._dist_meta = meta
+    return out
+
+
+def _materialize(arr, meta: DistMeta):
+    """Fold partial leading dims into the true value (sum/avg/max/min) —
+    XLA lowers the sharded-axis reduction to an all-reduce."""
+    n = len(meta.partial_axes)
+    if n == 0:
+        return arr, meta
+    red = {"sum": jnp.sum, "avg": jnp.mean, "max": jnp.max, "min": jnp.min}
+    for i, (mesh_dim, kind) in enumerate(reversed(meta.partial_axes)):
+        arr = red[kind](arr, axis=n - 1 - i)
+    new_placements = [
+        Replicate() if p.is_partial() else p for p in meta.placements
+    ]
+    return arr, DistMeta(meta.mesh, new_placements)
+
+
+def _inject_partial_dims(arr, target: DistMeta, already=()):
+    """Add one lead dim per target partial axis not in `already`, using the
+    kind's identity layout: sum -> value at coord 0 + zeros (the reference
+    r_to_p semantics); avg/max/min -> replicate (mean/max/min of copies is
+    the value — zeros would corrupt them)."""
+    have = set(already)
+    for j, (mesh_dim, kind) in enumerate(target.partial_axes):
+        if mesh_dim in have:
+            continue
+        size = target.mesh.shape[mesh_dim]
+        # insert the new lead dim at position j so lead dims stay in
+        # target.partial_axes (mesh-dim) order even when mixed with kept
+        # partial axes
+        expanded = jnp.expand_dims(arr, j)
+        if kind == "sum":
+            zeros = jnp.zeros(
+                arr.shape[:j] + (size - 1,) + arr.shape[j:], arr.dtype
+            )
+            arr = jnp.concatenate([expanded, zeros], axis=j)
+        else:
+            arr = jnp.broadcast_to(
+                expanded, arr.shape[:j] + (size,) + arr.shape[j:]
+            )
+    return arr
+
+
+def reshard(x: Tensor, mesh: ProcessMesh, placements):
+    """Placement transition (ref api.py:733 + reshard function registry:
+    r_to_s, s_to_r, p_to_r, p_to_s, r_to_p, s_to_s, nd-mesh compositions,
+    cross-mesh — all collapse to one pure function: reduce dropped
+    partials, inject new partials, device_put onto the target sharding).
+    Recorded on the tape when the source requires grad (jax.vjp of the
+    whole transition is the correct transposed reshard)."""
+    if x._dist_meta is None:
+        x = shard_tensor(x, mesh, [Replicate()] * mesh.ndim)
+    meta = x._dist_meta
+    target = DistMeta(mesh, placements)
+    cross_mesh = meta.mesh != mesh
+
+    def _apply(arr):
+        m = meta
+        # 1) drop partials the target doesn't keep (p->r / p->s): reduce
+        keep = set() if cross_mesh else {i for i, _ in target.partial_axes}
+        if any(i not in keep for i, _ in m.partial_axes):
+            arr, m = _materialize(arr, m)
+        kept = [i for i, _ in m.partial_axes]
+        # 2) add partials the target introduces (r->p)
+        arr = _inject_partial_dims(arr, target, already=kept)
+        return jax.device_put(
+            arr, _sharding(target, arr.ndim - len(target.partial_axes))
+        )
+
+    from ..core import autograd, dispatch
+
+    if not x.stop_gradient and autograd.is_grad_enabled():
+        saved = x._dist_meta
+        x._dist_meta = None
+        try:
+            out = dispatch.call("reshard", _apply, (x,), {})
+        finally:
+            x._dist_meta = saved
+    else:
+        out = Tensor(_apply(x._data), stop_gradient=x.stop_gradient)
+    out._dist_meta = target
+    return out
+
+
+def to_global_array(t: Tensor):
+    """Full (replicated) global value — used by Tensor.numpy()."""
+    meta = t._dist_meta
+    arr, _ = _materialize(t._data, meta)
+    return arr
+
+
+def dtensor_to_local(t: Tensor, mesh=None, placements=None):
+    """This-process local shard (ref api.py dtensor_to_local)."""
+    meta = t._dist_meta
+    if meta is None:
+        return t
+    local_arrs = [s.data for s in t._data.addressable_shards]
+    # single-controller: return the first addressable shard as the "local"
+    out = Tensor(local_arrs[0], stop_gradient=t.stop_gradient)
+    return out
+
+
+def unshard_dtensor(t: Tensor):
+    """DistTensor -> dense replicated Tensor (ref api.py:2947)."""
+    if t._dist_meta is None:
+        return t
+    arr = to_global_array(t)
+    out = Tensor(
+        jax.device_put(arr, NamedSharding(
+            t._dist_meta.mesh.jax_mesh(), PartitionSpec()
+        )),
+        stop_gradient=t.stop_gradient,
+    )
+    return out

@@ -1,0 +1,171 @@
+"""Parallel environment + high-level wrappers.
+
+ref: python/paddle/distributed/parallel.py (init_parallel_env:978,
+DataParallel:219), auto_parallel/api.py (shard_layer:844,
+shard_optimizer:1019). TCPStore/NCCL bootstrap collapses to the jax
+coordination service: under multi-host, `jax.distributed.initialize`
+performs the rendezvous the reference does with TCPStore + ncclUniqueId
+exchange (SURVEY §2.6 TPU-equivalent row).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .dist_tensor import shard_tensor
+from .placement import Replicate, Shard
+from .process_mesh import ProcessMesh
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+    "DataParallel", "shard_layer", "shard_optimizer", "default_mesh",
+]
+
+_parallel_env = None
+
+
+class ParallelEnv:
+    """ref: distributed/parallel.py:677 ParallelEnv (env-var contract
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM honoured for launcher parity;
+    device facts come from jax)."""
+
+    def __init__(self):
+        import jax
+
+        self.rank = int(
+            os.environ.get("PADDLE_TRAINER_ID", jax.process_index())
+        )
+        self.world_size = int(
+            os.environ.get("PADDLE_TRAINERS_NUM", jax.process_count())
+        )
+        self.device_count = len(jax.devices())
+        self.nranks = self.world_size
+        self.local_rank = self.rank
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+
+def init_parallel_env():
+    """Bring up the parallel context (ref parallel.py:978). Multi-host
+    initialization goes through jax.distributed (coordination service =
+    the TCPStore analogue); single-host is a no-op beyond building the
+    default device mesh."""
+    global _parallel_env
+    if _parallel_env is None:
+        coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
+            "MASTER_ADDR"
+        )
+        if coord and int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1:
+            import jax
+
+            jax.distributed.initialize()
+        _parallel_env = ParallelEnv()
+    return _parallel_env
+
+
+def get_rank(group=None):
+    env = init_parallel_env()
+    return env.rank
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return init_parallel_env().world_size
+
+
+_default_mesh = None
+
+
+def default_mesh():
+    """1-d mesh over all devices (the default DP axis)."""
+    global _default_mesh
+    if _default_mesh is None:
+        import jax
+
+        _default_mesh = ProcessMesh(
+            list(range(len(jax.devices()))), ["dp"]
+        )
+    return _default_mesh
+
+
+class DataParallel(Layer):
+    """ref: distributed/parallel.py:219. GSPMD data parallelism: inputs
+    are sharded along the mesh's dp axis; parameters stay replicated and
+    XLA inserts the gradient all-reduce when backward contracts over the
+    sharded batch dim — the EagerReducer bucket machinery (reducer.cc)
+    has no analogue to build."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None, mesh=None):
+        super().__init__()
+        self._layers = layers
+        self._mesh = mesh or default_mesh()
+
+    def forward(self, *inputs, **kwargs):
+        def _shard(x):
+            if isinstance(x, Tensor) and x._dist_meta is None and x.ndim > 0:
+                if x.shape[0] % self._mesh.shape[0] == 0:
+                    return shard_tensor(
+                        x, self._mesh,
+                        [Shard(0)] + [Replicate()] * (self._mesh.ndim - 1),
+                        stop_gradient=x.stop_gradient,
+                    )
+            return x
+
+        import jax
+
+        inputs = jax.tree_util.tree_map(
+            _shard, inputs, is_leaf=lambda v: isinstance(v, Tensor)
+        )
+        kwargs = jax.tree_util.tree_map(
+            _shard, kwargs, is_leaf=lambda v: isinstance(v, Tensor)
+        )
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+
+def shard_layer(layer: Layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Apply placements to every parameter (ref api.py:844). shard_fn
+    (name, layer, mesh) sets placements on sublayer params; default
+    replicates everything on the mesh."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for p in sublayer.parameters(include_sublayers=False):
+                if p is not None and p._dist_meta is None:
+                    d = shard_tensor(
+                        p, mesh, [Replicate()] * mesh.ndim,
+                        stop_gradient=p.stop_gradient,
+                    )
+                    p._rebind(d._data, dist_meta=d._dist_meta)
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """ref api.py:1019. Optimizer accumulators are created with
+    zeros_like(param) so they inherit each parameter's NamedSharding
+    automatically; ZeRO-style stages re-placement via shard_fn."""
+    if shard_fn is not None:
+        orig_init = optimizer._init_state
+
+        def wrapped(p_array):
+            st = orig_init(p_array)
+            return shard_fn(st, p_array)
+
+        optimizer._init_state = wrapped
+    return optimizer

@@ -1,0 +1,82 @@
+"""Placement types: Shard / Replicate / Partial.
+
+ref: paddle/phi/core/distributed/auto_parallel/placement_types.h and
+python/paddle/distributed/auto_parallel/placement_type.py. Placements are
+per-MESH-dimension: placements[i] says how the tensor is laid out along
+mesh dimension i (the dims_mapping model of dist_attr.h:81).
+"""
+from __future__ import annotations
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    """Tensor dimension `dim` is split across this mesh dimension."""
+
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    """Pending reduction along this mesh dimension: the true value is the
+    elementwise reduce of the per-coordinate values."""
+
+    def __init__(self, reduce_type="sum"):
+        if reduce_type not in ("sum", "avg", "max", "min"):
+            raise ValueError(f"bad reduce_type {reduce_type!r}")
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Partial)
+            and other.reduce_type == self.reduce_type
+        )
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"

@@ -1,0 +1,101 @@
+"""ProcessMesh — N-d mesh of devices with named axes.
+
+ref: paddle/phi/core/distributed/auto_parallel/process_mesh.h:34 and
+python/paddle/distributed/auto_parallel/process_mesh.py. TPU-first: lowers
+to jax.sharding.Mesh; process ids index jax.devices() so the same mesh
+works on the forced-8-device CPU platform, one real chip, or a multi-host
+slice (where jax.devices() spans hosts over ICI/DCN).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ProcessMesh"]
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, process_ids=None):
+        arr = np.asarray(mesh, dtype=np.int64)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        self._shape = list(arr.shape)
+        self._process_ids = arr.reshape(-1).tolist()
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} rank != mesh rank {arr.ndim}"
+            )
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def size(self):
+        return len(self._process_ids)
+
+    def get_dim_size(self, name_or_idx):
+        if isinstance(name_or_idx, str):
+            return self._shape[self._dim_names.index(name_or_idx)]
+        return self._shape[name_or_idx]
+
+    def get_mesh_with_dim(self, dim_name):
+        """Reorder so dim_name is first (ref process_mesh.py)."""
+        idx = self._dim_names.index(dim_name)
+        arr = np.asarray(self._process_ids).reshape(self._shape)
+        order = [idx] + [i for i in range(self.ndim) if i != idx]
+        names = [self._dim_names[i] for i in order]
+        return ProcessMesh(arr.transpose(order), names)
+
+    def jax_mesh(self):
+        """Lower to jax.sharding.Mesh (cached)."""
+        if self._jax_mesh is None:
+            import jax
+            from jax.sharding import Mesh
+
+            all_devs = {d.id: d for d in jax.devices()}
+            try:
+                devs = np.array(
+                    [all_devs[i] for i in self._process_ids], dtype=object
+                ).reshape(self._shape)
+            except KeyError as e:
+                raise RuntimeError(
+                    f"mesh references device id {e} but only "
+                    f"{len(all_devs)} devices exist"
+                ) from None
+            self._jax_mesh = Mesh(devs, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, ProcessMesh)
+            and self._shape == other._shape
+            and self._process_ids == other._process_ids
+            and self._dim_names == other._dim_names
+        )
+
+    def __hash__(self):
+        return hash(
+            (tuple(self._shape), tuple(self._process_ids),
+             tuple(self._dim_names))
+        )
+
+    def __repr__(self):
+        return (
+            f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+        )
