@@ -52,10 +52,12 @@ from . import amp  # noqa: E402
 from . import autograd  # noqa: E402
 from . import framework  # noqa: E402
 from . import distributed  # noqa: E402
+from . import io  # noqa: E402
 from . import jit  # noqa: E402
 from . import nn  # noqa: E402
 from . import optimizer  # noqa: E402
 from . import regularizer  # noqa: E402
+from . import vision  # noqa: E402
 from .framework.io_api import load, save  # noqa: E402
 from .nn.parameter import ParamAttr  # noqa: E402
 

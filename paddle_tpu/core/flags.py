@@ -87,7 +87,6 @@ define_flag("FLAGS_default_float_dtype", "float32", "default dtype for float ten
 define_flag("FLAGS_check_nan_inf", False, "scan every op output for NaN/Inf (debug net)")
 define_flag("FLAGS_check_nan_inf_level", 0, "0: error on nan/inf; 3: log only")
 define_flag("FLAGS_use_stride_kernel", True, "allow non-contiguous views (kept for API parity)")
-define_flag("FLAGS_eager_jit_ops", True, "compile eager per-op dispatches with jax.jit")
 define_flag("FLAGS_benchmark", False, "block on every op for benchmarking")
 define_flag("FLAGS_amp_dtype", "bfloat16", "default autocast dtype on TPU")
 define_flag("FLAGS_embedding_deterministic", 0, "force deterministic embedding grad")

@@ -46,13 +46,17 @@ class Group:
     """A collective group = an ordered list of global ranks backed by a
     1-d mesh over those devices (ref communication/group.py)."""
 
-    _next_id = 0
+    # id 0 is reserved for the world group (the reference's global group)
+    _next_id = 1
 
-    def __init__(self, ranks, name=None):
+    def __init__(self, ranks, name=None, _id=None):
         self.ranks = list(ranks)
         self.nranks = len(self.ranks)
-        self.id = Group._next_id
-        Group._next_id += 1
+        if _id is None:
+            self.id = Group._next_id
+            Group._next_id += 1
+        else:
+            self.id = _id
         self.name = name or f"group_{self.id}"
         self.process_mesh = ProcessMesh(self.ranks, ["rank"])
 
@@ -76,7 +80,7 @@ def _world():
 
     global _default_group
     if _default_group is None:
-        _default_group = Group(list(range(len(jax.devices()))), "default")
+        _default_group = Group(list(range(len(jax.devices()))), "default", _id=0)
         _groups[_default_group.id] = _default_group
     return _default_group
 
@@ -98,6 +102,15 @@ def destroy_process_group(group=None):
         _default_group = None
     else:
         _groups.pop(group.id, None)
+
+
+def _member_rank(g, rank, what):
+    r = g.get_group_rank(rank)
+    if r < 0:
+        raise ValueError(
+            f"{what} rank {rank} is not a member of {g!r}"
+        )
+    return r
 
 
 def _stacked(x, group):
@@ -144,16 +157,12 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True):
 
     if tensor is None:
         x, g = _stacked(tensor_or_list, group)
-        out_list = None
-    else:
-        out_list, (x, g) = tensor_or_list, _stacked(tensor, group)
-    gathered = F.reshape(x, [1, g.nranks] + list(x.shape[1:]))
-    out = F.tile(gathered, [g.nranks] + [1] * (x.ndim))
-    if out_list is not None:
-        for r in range(g.nranks):
-            out_list.append(F.getitem(x, (r,)))
-        return out_list
-    return out
+        gathered = F.reshape(x, [1, g.nranks] + list(x.shape[1:]))
+        return F.tile(gathered, [g.nranks] + [1] * (x.ndim))
+    out_list, (x, g) = tensor_or_list, _stacked(tensor, group)
+    for r in range(g.nranks):
+        out_list.append(F.getitem(x, (r,)))
+    return out_list
 
 
 def all_to_all(out_tensor_list, in_tensor_list=None, group=None,
@@ -186,7 +195,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     from .. import ops as F
 
     x, g = _stacked(tensor, group)
-    src_rank = g.get_group_rank(src) if src in g.ranks else src
+    src_rank = _member_rank(g, src, "src")
     piece = F.getitem(x, (slice(src_rank, src_rank + 1),))
     out = F.tile(piece, [g.nranks] + [1] * (x.ndim - 1))
     if isinstance(tensor, Tensor):
@@ -206,11 +215,13 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     fns = {"sum": F.sum, "avg": F.mean, "max": F.max, "min": F.min,
            "prod": F.prod}
     red = fns[op](x, axis=0, keepdim=True)
-    dst_rank = g.get_group_rank(dst) if dst in g.ranks else dst
+    dst_rank = _member_rank(g, dst, "dst")
     mask_np = np.zeros((g.nranks,) + (1,) * (x.ndim - 1), np.float32)
     mask_np[dst_rank] = 1.0
     mask = F.cast(Tensor(mask_np), x.dtype.name)
     out = x * (1 - mask) + F.tile(red, [g.nranks] + [1] * (x.ndim - 1)) * mask
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out._data, dist_meta=out._dist_meta)
     return out
 
 
@@ -233,7 +244,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
     out = F.reshape(
         red, [g.nranks, red.shape[0] // g.nranks] + list(red.shape[1:])
     )
-    if tensor_list is not None and isinstance(tensor, Tensor):
+    if isinstance(tensor, Tensor):
         tensor._rebind(out._data, dist_meta=out._dist_meta)
     return out
 
@@ -250,11 +261,14 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
             tensor._rebind(out._data, dist_meta=out._dist_meta)
         return out
     x, g = _stacked(tensor, group)
-    src_rank = g.get_group_rank(src) if src in g.ranks else src
+    src_rank = _member_rank(g, src, "src")
     piece = F.getitem(x, (src_rank,))
-    return F.reshape(
+    out = F.reshape(
         piece, [g.nranks, piece.shape[0] // g.nranks] + list(piece.shape[1:])
     )
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out._data, dist_meta=out._dist_meta)
+    return out
 
 
 def barrier(group=None):

@@ -1,0 +1,263 @@
+"""DataLoader (ref: python/paddle/io/reader.py:262 DataLoader;
+io/dataloader/dataloader_iter.py multiprocess workers + shared-memory
+transport; C++ core imperative/data_loader.cc).
+
+TPU-first host pipeline: the reference's fork-per-worker + shm design
+exists to parallelize CPU tensor decoding for GPU feeding. Feeding a TPU
+from Python, the bottleneck is batch assembly + H2D, so the pipeline is:
+worker THREADS (numpy collate releases the GIL for big copies) pulling
+index batches, a bounded prefetch queue, and asynchronous device_put of
+the next batch while the current one trains (the async-H2D double
+buffering the reference gets from its DataFeed). num_workers=0 degrades
+to synchronous iteration.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn"]
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched arrays (ref io/dataloader/collate.py)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s._data) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {
+            k: default_collate_fn([s[k] for s in batch]) for k in sample
+        }
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(
+            default_collate_fn(list(items)) for items in transposed
+        )
+    raise TypeError(f"cannot collate batch of {type(sample)}")
+
+
+def _to_device(obj, place=None):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, Tensor):
+        return obj
+    if isinstance(obj, dict):
+        return {k: _to_device(v, place) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_device(v, place) for v in obj)
+    return obj
+
+
+class _Prefetcher:
+    """Bounded background producer over a batch iterator.
+
+    Batches are tagged with their production index and re-ordered on the
+    consumer side, preserving the reference DataLoader's in-order contract
+    (dataloader_iter.py _rcvd_idx reordering) regardless of per-batch
+    collate latency across threads.
+    """
+
+    _DONE = object()
+
+    def __init__(self, gen_fn, depth, num_threads):
+        self._q = queue.Queue(maxsize=depth)
+        self._gen_fn = gen_fn
+        self._threads = []
+        self._lock = threading.Lock()
+        self._iter = None
+        self._stop = threading.Event()
+        self._n = num_threads
+        self._next_idx = 0
+
+    def start(self):
+        self._iter = self._gen_fn()
+        for _ in range(self._n):
+            t = threading.Thread(target=self._work, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _next_job(self):
+        with self._lock:
+            try:
+                job = next(self._iter)
+            except StopIteration:
+                return None, self._DONE
+            except Exception as e:  # producer failure must reach consumer
+                return None, e
+            idx = self._next_idx
+            self._next_idx += 1
+            return idx, job
+
+    def _put(self, item):
+        """Queue put that stays responsive to shutdown (never blocks
+        forever on a full queue after the consumer abandoned us)."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _work(self):
+        while not self._stop.is_set():
+            idx, job = self._next_job()
+            if job is self._DONE:
+                self._put((None, self._DONE))
+                return
+            if isinstance(job, Exception):
+                self._put((None, job))
+                return
+            try:
+                self._put((idx, job()))
+            except Exception as e:
+                self._put((None, e))
+                return
+
+    def __iter__(self):
+        done = 0
+        pending = {}
+        want = 0
+        while True:
+            item = self._q.get()
+            idx, payload = item
+            if payload is self._DONE:
+                done += 1
+                if done == self._n:
+                    # drain any stragglers already produced in order
+                    while want in pending:
+                        yield pending.pop(want)
+                        want += 1
+                    return
+                continue
+            if isinstance(payload, Exception):
+                self.shutdown()
+                raise payload
+            pending[idx] = payload
+            while want in pending:
+                yield pending.pop(want)
+                want += 1
+
+    def shutdown(self):
+        self._stop.set()
+        # unblock any producer stuck on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+
+class DataLoader:
+    """ref: io/reader.py:262. Supported: map + iterable datasets, custom
+    sampler/batch_sampler/collate_fn, shuffle, drop_last, num_workers
+    (threads), prefetch_factor."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=False, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = max(0, int(num_workers))
+        self.prefetch_factor = max(1, int(prefetch_factor))
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+
+        if self._iterable_mode:
+            if batch_sampler is not None or shuffle:
+                raise ValueError(
+                    "IterableDataset does not support sampler/shuffle"
+                )
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = batch_sampler.batch_size
+        else:
+            if batch_size is None:
+                raise ValueError("batch_size or batch_sampler required")
+            self.batch_size = batch_size
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last,
+            )
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def _batches_map(self):
+        for indices in self.batch_sampler:
+            yield [self.dataset[i] for i in indices]
+
+    def _batches_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not getattr(self, "drop_last", False):
+            yield batch
+
+    def _produce(self):
+        gen = (
+            self._batches_iterable()
+            if self._iterable_mode
+            else self._batches_map()
+        )
+        for batch in gen:
+            yield batch
+
+    def __iter__(self):
+        if self.num_workers == 0:
+            for batch in self._produce():
+                yield _to_device(self.collate_fn(batch))
+            return
+
+        def job_stream():
+            if self._iterable_mode:
+                # iterable datasets must be pulled sequentially; workers
+                # parallelize collate + H2D only
+                for batch in self._batches_iterable():
+                    yield (lambda b=batch: _to_device(self.collate_fn(b)))
+            else:
+                # map-style: item loading happens INSIDE the job so worker
+                # threads overlap dataset reads (the reference's
+                # multiprocess worker loop, worker.py:293)
+                for indices in self.batch_sampler:
+                    yield (
+                        lambda idx=indices: _to_device(
+                            self.collate_fn(
+                                [self.dataset[i] for i in idx]
+                            )
+                        )
+                    )
+
+        pf = _Prefetcher(
+            job_stream,
+            depth=self.prefetch_factor * self.num_workers,
+            num_threads=self.num_workers,
+        )
+        pf.start()
+        try:
+            yield from pf
+        finally:
+            pf.shutdown()

@@ -795,15 +795,50 @@ def grid_sample(x, grid, *, mode="bilinear", padding_mode="zeros", align_corners
 
 
 # ---- attention -----------------------------------------------------------
+def _pallas_attention_eligible(query, key, value, attn_mask, dropout_p,
+                               is_causal):
+    """Kernel contract: flag on, no mask/dropout, block-divisible seq
+    lengths, head_dim within one VMEM tile budget, matching q/k/v head
+    counts and dims. Causal cross-length attention is excluded: the
+    kernel masks with absolute (top-left aligned) indices while the math
+    fallback bottom-right aligns (tril k=kl-ql) — KV-cache decode must
+    take the math path."""
+    from ...core import flags
+
+    if not flags.get_flag("FLAGS_use_pallas_kernels"):
+        return False
+    if attn_mask is not None or dropout_p > 0.0:
+        return False
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    if key.shape[2] != h or value.shape[2] != h or value.shape[3] != d:
+        return False
+    if is_causal and sq != sk:
+        return False
+    if d > 256 or d % 8 != 0:
+        return False
+    # real-TPU tile constraint: sequence blocks of 128 lanes
+    return sq % 128 == 0 and sk % 128 == 0
+
+
 def scaled_dot_product_attention(
     query, key, value, attn_mask=None, *, key_rng=None, dropout_p=0.0,
     is_causal=False, scale=None
 ):
-    """Math fallback (ref: nn/functional/flash_attention.py:976). Layout:
-    [batch, seq, heads, head_dim] like the reference; the Pallas flash
-    kernel (kernels/pallas/flash_attention.py) overrides this on TPU.
-    Attention dropout is applied to the probabilities when dropout_p > 0
-    (key_rng is plumbed by the generated wrapper)."""
+    """ref: nn/functional/flash_attention.py:976 (math form) + :242
+    (flash path). Layout: [batch, seq, heads, head_dim] like the
+    reference. When FLAGS_use_pallas_kernels is set and the call fits the
+    kernel contract (no mask, no dropout, block-divisible lengths), the
+    Pallas flash kernel (kernels/pallas/flash_attention.py) runs instead
+    of the math fallback. Attention dropout applies to the probabilities
+    when dropout_p > 0 (key_rng plumbed by the generated wrapper)."""
+    if _pallas_attention_eligible(query, key, value, attn_mask, dropout_p,
+                                  is_causal):
+        from ...kernels.pallas.flash_attention import flash_attention
+
+        return flash_attention(
+            query, key, value, causal=is_causal, scale=scale
+        )
     q = jnp.swapaxes(query, 1, 2).astype(jnp.float32)  # [b, h, s, d]
     k = jnp.swapaxes(key, 1, 2).astype(jnp.float32)
     v = jnp.swapaxes(value, 1, 2).astype(jnp.float32)

@@ -1,0 +1,5 @@
+"""paddle.vision analogue (ref: python/paddle/vision/__init__.py)."""
+from . import datasets, transforms
+from . import models
+
+__all__ = ["datasets", "transforms", "models"]

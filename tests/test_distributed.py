@@ -402,3 +402,22 @@ class TestReviewRegressions:
             np.testing.assert_allclose(
                 out.numpy()[r], chunks[r].numpy(), rtol=1e-6
             )
+
+
+class TestCommunicationContract:
+    def test_reduce_rebinds_input(self):
+        x = paddle.to_tensor(_np((8, 4)))
+        dist.reduce(x, dst=2)
+        got = x.numpy()
+        np.testing.assert_allclose(got[2], _np((8, 4)).sum(0), rtol=1e-5)
+
+    def test_broadcast_nonmember_src_raises(self):
+        g = dist.new_group([4, 5, 6, 7])
+        x = paddle.to_tensor(_np((4, 2)))
+        with pytest.raises(ValueError):
+            dist.broadcast(x, src=2, group=g)
+
+    def test_group_id_zero_is_world(self):
+        g = dist.new_group([0, 1])
+        assert g.id != 0
+        assert dist.get_group(0).nranks == 8

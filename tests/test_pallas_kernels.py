@@ -1,0 +1,147 @@
+"""Pallas kernel tests (interpret mode on CPU; real kernels on TPU).
+
+ref test strategy: numeric comparison of the fused kernel against the
+math fallback (the reference tests flash_attention against the unfused
+computation, test/legacy_test/test_flash_attention.py).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.kernels.pallas.flash_attention import flash_attention
+
+
+def _ref(q, k, v, causal, scale=None):
+    d = q.shape[-1]
+    s = np.einsum(
+        "bqhd,bkhd->bhqk", q.astype(np.float64), k.astype(np.float64)
+    ) * (scale or 1.0 / np.sqrt(d))
+    if causal:
+        m = np.tril(np.ones(s.shape[-2:], bool))
+        s = np.where(m, s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bkhd->bqhd", p, v.astype(np.float64)).astype(
+        np.float32
+    )
+
+
+@pytest.fixture
+def qkv():
+    rng = np.random.RandomState(0)
+    mk = lambda: rng.randn(2, 256, 2, 64).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+class TestFlashAttention:
+    def test_full_matches_math(self, qkv):
+        q, k, v = qkv
+        out = np.asarray(
+            flash_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=False
+            )
+        )
+        np.testing.assert_allclose(
+            out, _ref(q, k, v, False), rtol=2e-4, atol=2e-5
+        )
+
+    def test_causal_matches_math(self, qkv):
+        q, k, v = qkv
+        out = np.asarray(
+            flash_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True
+            )
+        )
+        np.testing.assert_allclose(
+            out, _ref(q, k, v, True), rtol=2e-4, atol=2e-5
+        )
+
+    def test_cross_attention_lengths(self):
+        rng = np.random.RandomState(1)
+        q = rng.randn(1, 128, 2, 64).astype(np.float32)
+        k = rng.randn(1, 384, 2, 64).astype(np.float32)
+        v = rng.randn(1, 384, 2, 64).astype(np.float32)
+        out = np.asarray(
+            flash_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=False
+            )
+        )
+        np.testing.assert_allclose(
+            out, _ref(q, k, v, False), rtol=2e-4, atol=2e-5
+        )
+
+    def test_gradients_match_math(self, qkv):
+        q, k, v = qkv
+
+        def loss_flash(q, k, v):
+            return flash_attention(q, k, v, causal=True).sum()
+
+        def loss_math(q, k, v):
+            qf, kf, vf = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+            s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) / np.sqrt(q.shape[-1])
+            mask = jnp.tril(jnp.ones(s.shape[-2:], bool))
+            s = jnp.where(mask, s, -1e30)
+            p = jax.nn.softmax(s, -1)
+            return jnp.einsum("bhqk,bhkd->bhqd", p, vf).sum()
+
+        args = tuple(jnp.asarray(x) for x in (q, k, v))
+        g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(*args)
+        g2 = jax.grad(loss_math, argnums=(0, 1, 2))(*args)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-5
+            )
+
+    def test_sdpa_dispatches_to_pallas(self):
+        """The op routes causal/no-mask calls through the kernel when the
+        flag is set, and both paths agree."""
+        rng = np.random.RandomState(2)
+        q = paddle.to_tensor(rng.randn(1, 128, 2, 64).astype(np.float32))
+        with_flag = paddle.scaled_dot_product_attention(
+            q, q, q, None, 0.0, True
+        ).numpy()
+        paddle.set_flags({"FLAGS_use_pallas_kernels": False})
+        try:
+            math_out = paddle.scaled_dot_product_attention(
+                q, q, q, None, 0.0, True
+            ).numpy()
+        finally:
+            paddle.set_flags({"FLAGS_use_pallas_kernels": True})
+        np.testing.assert_allclose(with_flag, math_out, rtol=2e-4, atol=2e-5)
+
+    def test_sdpa_fallback_on_mask(self):
+        """Masked/dropout calls stay on the math path (kernel contract)."""
+        rng = np.random.RandomState(3)
+        q = paddle.to_tensor(rng.randn(1, 128, 2, 64).astype(np.float32))
+        mask = paddle.to_tensor(
+            np.zeros((1, 1, 128, 128), np.float32)
+        )
+        out = paddle.scaled_dot_product_attention(q, q, q, mask)
+        assert out.shape == [1, 128, 2, 64]
+
+    def test_bf16_path(self, qkv):
+        q, k, v = (x.astype(jnp.bfloat16) for x in map(jnp.asarray, qkv))
+        out = flash_attention(q, k, v, causal=True)
+        assert out.dtype == jnp.bfloat16
+        ref = _ref(*[np.asarray(x, np.float32) for x in (q, k, v)], True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), ref, rtol=2e-2, atol=2e-2
+        )
+
+    def test_llama_uses_flash_when_eligible(self):
+        """End to end: Llama attention at seq=128 hits the kernel path and
+        still trains."""
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny(hidden_size=128,
+                                              num_attention_heads=2))
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (2, 128)).astype(np.int32)
+        )
+        logits, loss = m(ids, labels=ids)
+        loss.backward()
+        assert all(p.grad is not None for p in m.parameters())
