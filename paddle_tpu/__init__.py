@@ -51,7 +51,12 @@ from .autograd import grad  # noqa: E402  (needs patched Tensor)
 from . import amp  # noqa: E402
 from . import autograd  # noqa: E402
 from . import framework  # noqa: E402
+from . import device  # noqa: E402
 from . import distributed  # noqa: E402
+from . import hapi  # noqa: E402
+from .hapi import Model  # noqa: E402
+from . import metric  # noqa: E402
+from . import profiler  # noqa: E402
 from . import io  # noqa: E402
 from . import jit  # noqa: E402
 from . import nn  # noqa: E402

@@ -192,7 +192,7 @@ def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weigh
     master_weight is implicit (the reference's master-grad pass analogue).
     """
     if level == "O2":
-        from ..nn.layer import Layer
+        from ..nn.layer.layers import Layer
 
         model_list = models if isinstance(models, (list, tuple)) else [models]
         target = convert_dtype(dtype).name

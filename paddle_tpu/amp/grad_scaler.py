@@ -43,6 +43,11 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        if getattr(self, "_unscaled", False):
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update()"
+            )
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list:
@@ -53,6 +58,7 @@ class GradScaler:
                     found = True
                 p.grad = g * inv
         self._found_inf = found
+        self._unscaled = True
 
     def step(self, optimizer):
         if not self._enable:

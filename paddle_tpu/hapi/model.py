@@ -1,0 +1,240 @@
+"""High-level Model API (ref: python/paddle/hapi/model.py:1472 —
+Model.prepare/fit/evaluate/predict/save/load).
+
+TPU-first: fit() drives the jit-staged TrainStep (one fused XLA program
+per step) instead of the reference's per-op dygraph loop or static
+Executor; the rest of the UX (prepare, metrics, callbacks) mirrors the
+reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import jit
+from ..core.tensor import Tensor
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from .callbacks import Callback, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+
+    # -- configuration -----------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        ms = _as_list(metrics)
+        for m in ms:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m!r} is not a paddle.metric.Metric")
+        self._metrics = ms
+        self._train_step = None
+        return self
+
+    # -- internals ---------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle, num_workers,
+                drop_last=None):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(
+                data, batch_size=batch_size, shuffle=shuffle,
+                num_workers=num_workers,
+                drop_last=shuffle if drop_last is None else drop_last,
+            )
+        raise TypeError(f"cannot build a DataLoader from {type(data)}")
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)):
+            *xs, y = batch
+            return xs, y
+        return [batch], None
+
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            loss_fn = self._loss
+
+            def step_fn(network, *args):
+                *xs, y = args
+                out = network(*xs)
+                return loss_fn(out, y)
+
+            self._train_step = jit.TrainStep(
+                self.network, step_fn, self._optimizer, donate=False
+            )
+        return self._train_step
+
+    # -- train/eval/predict ------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        assert self._optimizer is not None and self._loss is not None, (
+            "call prepare(optimizer, loss) before fit"
+        )
+        loader = self._loader(
+            train_data, batch_size, shuffle, num_workers,
+            drop_last=drop_last or shuffle,
+        )
+        eval_loader = self._loader(eval_data, batch_size, False, num_workers)
+        cbs = _as_list(callbacks) or [ProgBarLogger(log_freq, verbose)]
+        for cb in cbs:
+            cb.set_model(self)
+
+        step_fn = self._ensure_train_step()
+        self.stop_training = False
+        history = {"loss": []}
+        for cb in cbs:
+            cb.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            self.network.train()
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
+            epoch_losses = []
+            for step, batch in enumerate(loader):
+                for cb in cbs:
+                    cb.on_train_batch_begin(step)
+                xs, y = self._split_batch(batch)
+                loss = step_fn(*xs, y)
+                val = float(loss.numpy())
+                epoch_losses.append(val)
+                logs = {"loss": val}
+                for cb in cbs:
+                    cb.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            epoch_log = {"loss": float(np.mean(epoch_losses))}
+            history["loss"].append(epoch_log["loss"])
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(
+                    eval_loader, batch_size=batch_size, verbose=0,
+                    num_workers=num_workers, callbacks=cbs,
+                )
+                epoch_log.update(eval_logs)
+            for cb in cbs:
+                cb.on_epoch_end(epoch, epoch_log)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                import os
+
+                os.makedirs(save_dir, exist_ok=True)
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training:
+                break
+        for cb in cbs:
+            cb.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._loader(eval_data, batch_size, False, num_workers)
+        cbs = _as_list(callbacks)
+        self.network.eval()
+        for m in self._metrics:
+            m.reset()
+        for cb in cbs:
+            cb.on_eval_begin()
+        losses = []
+        from ..core import autograd
+
+        with autograd.no_grad():
+            for batch in loader:
+                xs, y = self._split_batch(batch)
+                out = self.network(*xs)
+                if self._loss is not None and y is not None:
+                    losses.append(float(self._loss(out, y).numpy()))
+                for m in self._metrics:
+                    computed = m.compute(out, y)
+                    if isinstance(computed, tuple):
+                        m.update(*computed)
+                    else:
+                        m.update(computed)
+        logs = {}
+        if losses:
+            logs["eval_loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name()
+            vals = m.accumulate()
+            if isinstance(names, list):
+                vals = vals if isinstance(vals, (list, tuple)) else [vals]
+                for n, v in zip(names, vals):
+                    logs[f"eval_{n}"] = v
+            else:
+                logs[f"eval_{names}"] = vals
+        for cb in cbs:
+            cb.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._loader(test_data, batch_size, False, num_workers)
+        self.network.eval()
+        outs = []
+        from ..core import autograd
+
+        with autograd.no_grad():
+            for batch in loader:
+                xs, _ = self._split_batch(batch)
+                out = self.network(*xs)
+                outs.append(
+                    out.numpy() if isinstance(out, Tensor) else out
+                )
+        if stack_outputs:
+            return np.concatenate(outs)
+        return outs
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        from .. import save as paddle_save
+
+        paddle_save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            paddle_save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from .. import load as paddle_load
+
+        self.network.set_state_dict(paddle_load(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None:
+            import os
+
+            if os.path.exists(path + ".pdopt"):
+                self._optimizer.set_state_dict(paddle_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(
+            int(np.prod(p.shape)) for p in self.network.parameters()
+        )
+        lines = [f"{type(self.network).__name__}: {n_params:,} parameters"]
+        for name, sub in self.network.named_sublayers():
+            cnt = sum(
+                int(np.prod(p.shape))
+                for p in sub.parameters(include_sublayers=False)
+            )
+            if cnt:
+                lines.append(f"  {name}: {cnt:,}")
+        out = "\n".join(lines)
+        print(out)
+        return {"total_params": n_params}

@@ -50,8 +50,6 @@ class Layer:
         default_initializer=None,
     ):
         attr = ParamAttr._to_attr(attr)
-        if attr.trainable is False and False:
-            pass
         dtype = dtype or self._dtype
         init = (
             attr.initializer

@@ -22,15 +22,6 @@ def linear(x, weight, bias=None):
 
 
 # ---- convolutions --------------------------------------------------------
-def _conv_dims(data_format, spatial):
-    if data_format in ("NCHW", "NCL", "NCDHW"):
-        lhs = ("N", "C") + tuple(str(i) for i in range(spatial))
-    else:
-        lhs = ("N",) + tuple(str(i) for i in range(spatial)) + ("C",)
-    lhs_spec = "".join(d if d in ("N", "C") else d for d in lhs)
-    return lhs
-
-
 def _normalize_tuple(v, n):
     if isinstance(v, (int, np.integer)):
         return (int(v),) * n

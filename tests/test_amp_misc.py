@@ -1,0 +1,272 @@
+"""AMP, GradScaler, io_api, initializer, and remaining nn_ops coverage
+(the VERDICT-flagged untested surfaces; reference patterns:
+test/amp/test_amp_api.py, test/legacy_test/test_initializer.py,
+test_bicubic_interp_v2_op.py, test_grid_sampler_op.py)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.amp import GradScaler, auto_cast, decorate
+from paddle_tpu.nn import initializer as I
+
+
+class TestAutoCast:
+    def test_o1_matmul_bf16(self):
+        x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        with auto_cast(True):
+            out = paddle.matmul(x, x)
+        assert out.dtype.name == "bfloat16"
+        # blacklisted op stays fp32
+        with auto_cast(True):
+            s = paddle.sum(x)
+        assert s.dtype.name == "float32"
+
+    def test_o1_off_outside_context(self):
+        x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        out = paddle.matmul(x, x)
+        assert out.dtype.name == "float32"
+
+    def test_custom_lists(self):
+        x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        with auto_cast(True, custom_black_list={"matmul"}):
+            out = paddle.matmul(x, x)
+        assert out.dtype.name == "float32"
+
+    def test_o2_decorate(self):
+        model = nn.Linear(4, 4)
+        model2 = decorate(models=model, optimizers=None, level="O2")
+        assert model2.weight.dtype.name == "bfloat16"
+
+    def test_grad_flows_through_cast(self):
+        x = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        w = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        w.stop_gradient = False
+        with auto_cast(True):
+            loss = paddle.matmul(x, w).sum()
+        loss.backward()
+        assert w.grad is not None
+        assert w.grad.shape == [4, 4]
+
+
+class TestGradScalerFP16:
+    def _param(self, v):
+        from paddle_tpu.nn.parameter import Parameter
+
+        return Parameter(np.asarray(v, np.float32))
+
+    def test_scale_and_unscale_roundtrip(self):
+        p = self._param([1.0])
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = GradScaler(init_loss_scaling=1024.0)
+        loss = (p * 2.0).sum()
+        scaled = scaler.scale(loss)
+        np.testing.assert_allclose(
+            scaled.numpy(), loss.numpy() * 1024.0, rtol=1e-6
+        )
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        # grad was unscaled before the step: p = 1 - 0.1*2
+        np.testing.assert_allclose(p.numpy(), [0.8], rtol=1e-5)
+
+    def test_inf_grad_skips_step_and_decays_scale(self):
+        p = self._param([1.0])
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = GradScaler(init_loss_scaling=1024.0,
+                            decr_every_n_nan_or_inf=1)
+        p.grad = paddle.to_tensor(np.asarray([np.inf], np.float32))
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(p.numpy(), [1.0])  # skipped
+        assert scaler._scale == 512.0
+
+    def test_scale_grows_after_good_steps(self):
+        p = self._param([1.0])
+        opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=[p])
+        scaler = GradScaler(init_loss_scaling=2.0, incr_every_n_steps=2)
+        for _ in range(2):
+            p.grad = paddle.to_tensor(np.asarray([1.0], np.float32))
+            scaler.step(opt)
+            scaler.update()
+        assert scaler._scale == 4.0
+
+    def test_disabled_passthrough(self):
+        scaler = GradScaler(enable=False)
+        x = paddle.to_tensor(np.asarray([2.0], np.float32))
+        assert scaler.scale(x) is x
+
+
+class TestIOApi:
+    def test_nested_structures_roundtrip(self, tmp_path):
+        obj = {
+            "w": paddle.to_tensor(np.random.randn(3, 3).astype(np.float32)),
+            "meta": {"lr": 0.1, "steps": [1, 2, 3]},
+            "name": "ckpt",
+        }
+        path = str(tmp_path / "obj.pdparams")
+        paddle.save(obj, path)
+        loaded = paddle.load(path)
+        np.testing.assert_allclose(
+            loaded["w"].numpy(), obj["w"].numpy(), rtol=1e-6
+        )
+        assert loaded["meta"]["lr"] == 0.1
+        assert loaded["name"] == "ckpt"
+
+    def test_bf16_tensor_roundtrip(self, tmp_path):
+        x = paddle.to_tensor(
+            np.random.randn(4).astype(np.float32)
+        ).astype("bfloat16")
+        path = str(tmp_path / "bf16.pdparams")
+        paddle.save({"x": x}, path)
+        loaded = paddle.load(path)
+        assert loaded["x"].dtype.name == "bfloat16"
+        np.testing.assert_allclose(
+            loaded["x"].astype("float32").numpy(),
+            x.astype("float32").numpy(),
+        )
+
+    def test_layer_state_dict_roundtrip(self, tmp_path):
+        m = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+        m(paddle.to_tensor(np.random.randn(4, 4).astype(np.float32)))
+        path = str(tmp_path / "m.pdparams")
+        paddle.save(m.state_dict(), path)
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+        missing, unexpected = m2.set_state_dict(paddle.load(path))
+        assert not missing and not unexpected
+        np.testing.assert_allclose(
+            m2[1]._mean.numpy(), m[1]._mean.numpy(), rtol=1e-6
+        )
+
+
+class TestInitializers:
+    def test_constant_uniform_normal(self):
+        assert np.all(I.Constant(3.0)([4, 4], dtype="float32") == 3.0)
+        u = I.Uniform(-0.5, 0.5)([1000], dtype="float32")
+        assert np.asarray(u).min() >= -0.5 and np.asarray(u).max() <= 0.5
+        n = np.asarray(I.Normal(0.0, 2.0)([5000], dtype="float32"))
+        assert abs(n.std() - 2.0) < 0.2
+
+    def test_xavier_kaiming_scale(self):
+        w = np.asarray(I.XavierNormal()([256, 256], dtype="float32"))
+        assert abs(w.std() - np.sqrt(2.0 / 512)) < 0.01
+        k = np.asarray(I.KaimingNormal()([256, 256], dtype="float32"))
+        assert abs(k.std() - np.sqrt(2.0 / 256)) < 0.01
+
+    def test_orthogonal(self):
+        w = np.asarray(I.Orthogonal()([64, 64], dtype="float32"))
+        np.testing.assert_allclose(
+            w @ w.T, np.eye(64), atol=1e-4
+        )
+
+
+class TestNnOpsExtras:
+    def test_interpolate_bilinear_matches_torch(self):
+        x = np.random.RandomState(0).randn(1, 2, 4, 4).astype(np.float32)
+        got = paddle.interpolate(
+            paddle.to_tensor(x), size=[8, 8], mode="bilinear",
+            align_corners=False,
+        ).numpy()
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(x), size=(8, 8), mode="bilinear",
+            align_corners=False,
+        ).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_interpolate_nearest(self):
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+        got = paddle.interpolate(
+            paddle.to_tensor(x), scale_factor=2, mode="nearest"
+        ).numpy()
+        want = torch.nn.functional.interpolate(
+            torch.from_numpy(x), scale_factor=2, mode="nearest"
+        ).numpy()
+        np.testing.assert_allclose(got, want)
+
+    def test_grid_sample_matches_torch(self):
+        x = np.random.RandomState(1).randn(1, 2, 5, 5).astype(np.float32)
+        g = np.random.RandomState(2).uniform(
+            -1, 1, (1, 3, 3, 2)
+        ).astype(np.float32)
+        got = paddle.grid_sample(
+            paddle.to_tensor(x), paddle.to_tensor(g), "bilinear", "zeros",
+            True,
+        ).numpy()
+        want = torch.nn.functional.grid_sample(
+            torch.from_numpy(x), torch.from_numpy(g), "bilinear", "zeros",
+            True,
+        ).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_pixel_shuffle_matches_torch(self):
+        x = np.random.RandomState(3).randn(1, 8, 3, 3).astype(np.float32)
+        got = paddle.pixel_shuffle(paddle.to_tensor(x), 2).numpy()
+        want = torch.pixel_shuffle(torch.from_numpy(x), 2).numpy()
+        np.testing.assert_allclose(got, want)
+
+    def test_unfold_matches_torch(self):
+        x = np.random.RandomState(4).randn(1, 2, 5, 5).astype(np.float32)
+        got = paddle.unfold(paddle.to_tensor(x), [3, 3], 1, 0, 1).numpy()
+        want = torch.nn.functional.unfold(
+            torch.from_numpy(x), (3, 3)
+        ).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_normalize_cosine_similarity(self):
+        a = np.random.RandomState(5).randn(4, 8).astype(np.float32)
+        b = np.random.RandomState(6).randn(4, 8).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.nn.functional.normalize(paddle.to_tensor(a)).numpy(),
+            torch.nn.functional.normalize(torch.from_numpy(a)).numpy(),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            paddle.nn.functional.cosine_similarity(
+                paddle.to_tensor(a), paddle.to_tensor(b)
+            ).numpy(),
+            torch.nn.functional.cosine_similarity(
+                torch.from_numpy(a), torch.from_numpy(b)
+            ).numpy(),
+            rtol=1e-5,
+        )
+
+
+class TestNonLeafHook:
+    def test_hook_fires_on_intermediate(self):
+        calls = []
+        x = paddle.to_tensor(np.asarray([2.0], np.float32))
+        x.stop_gradient = False
+        y = x * 3.0  # intermediate
+        y.register_hook(lambda g: calls.append(np.asarray(g._data)) or None)
+        (y * 2.0).sum().backward()
+        assert len(calls) == 1
+        np.testing.assert_allclose(calls[0], [2.0])
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_hook_can_modify_intermediate_grad(self):
+        x = paddle.to_tensor(np.asarray([1.0], np.float32))
+        x.stop_gradient = False
+        y = x * 2.0
+        y.register_hook(lambda g: g * 10.0)
+        y.sum().backward()
+        # dy scaled by 10 before flowing into the mul vjp: dx = 10*2
+        np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+    def test_hook_remove(self):
+        calls = []
+        x = paddle.to_tensor(np.asarray([1.0], np.float32))
+        x.stop_gradient = False
+        y = x * 2.0
+        h = y.register_hook(lambda g: calls.append(1))
+        h.remove()
+        y.sum().backward()
+        assert not calls
+
+    def test_leaf_hook_still_fires(self):
+        calls = []
+        x = paddle.to_tensor(np.asarray([1.0], np.float32))
+        x.stop_gradient = False
+        x.register_hook(lambda g: calls.append(1))
+        (x * 2.0).sum().backward()
+        assert calls == [1]
