@@ -34,6 +34,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = False
 
     def scale(self, var):
         if not self._enable:
@@ -75,6 +76,7 @@ class GradScaler:
         self.update()
 
     def update(self):
+        self._unscaled = False
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

@@ -55,9 +55,17 @@ def memory_allocated(device=None):
     return int(_stats(device).get("bytes_in_use", 0))
 
 
+_peak_offsets = {}
+
+
 def max_memory_allocated(device=None):
-    """Peak live bytes (ref paddle.device.cuda.max_memory_allocated)."""
-    return int(_stats(device).get("peak_bytes_in_use", 0))
+    """Peak live bytes since the last reset_max_memory_allocated (ref
+    paddle.device.cuda.max_memory_allocated). PJRT reports process-
+    lifetime peaks; resets are emulated with a per-device offset."""
+    d = _resolve(device)
+    peak = int(_stats(device).get("peak_bytes_in_use", 0))
+    base = _peak_offsets.get(id(d), 0)
+    return max(peak - base, 0)
 
 
 def memory_reserved(device=None):
@@ -71,9 +79,10 @@ def max_memory_reserved(device=None):
 
 
 def reset_max_memory_allocated(device=None):
-    """PJRT has no peak-reset hook; record an offset so subsequent reads
-    are relative (documented deviation)."""
-    return None
+    """PJRT has no peak-reset hook; records the current peak as an
+    offset so subsequent max_memory_allocated reads are relative."""
+    d = _resolve(device)
+    _peak_offsets[id(d)] = int(_stats(device).get("peak_bytes_in_use", 0))
 
 
 def empty_cache():

@@ -92,7 +92,9 @@ class EarlyStopping(Callback):
             self.best = np.inf
 
     def on_eval_end(self, logs=None):
-        cur = (logs or {}).get(self.monitor)
+        logs = logs or {}
+        # evaluate() prefixes its keys with "eval_"; accept both spellings
+        cur = logs.get(self.monitor, logs.get(f"eval_{self.monitor}"))
         if cur is None:
             return
         cur = float(np.asarray(cur).reshape(-1)[0])
@@ -116,12 +118,25 @@ class LRScheduler(Callback):
         lr = getattr(self.model._optimizer, "_learning_rate", None)
         return lr if isinstance(lr, Sched) else None
 
+    def _step(self, s, logs):
+        from ..optimizer.lr import ReduceOnPlateau
+
+        if isinstance(s, ReduceOnPlateau):
+            metric = (logs or {}).get("eval_loss", (logs or {}).get("loss"))
+            if metric is not None:
+                s.step(metric)
+            return
+        s.step()
+
     def on_train_batch_end(self, step, logs=None):
         s = self._sched()
         if self.by_step and s is not None:
-            s.step()
+            from ..optimizer.lr import ReduceOnPlateau
+
+            if not isinstance(s, ReduceOnPlateau):  # plateau is epoch-wise
+                s.step()
 
     def on_epoch_end(self, epoch, logs=None):
         s = self._sched()
         if self.by_epoch and s is not None:
-            s.step()
+            self._step(s, logs)

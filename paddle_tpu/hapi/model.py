@@ -91,7 +91,7 @@ class Model:
         )
         loader = self._loader(
             train_data, batch_size, shuffle, num_workers,
-            drop_last=drop_last or shuffle,
+            drop_last=drop_last,
         )
         eval_loader = self._loader(eval_data, batch_size, False, num_workers)
         cbs = _as_list(callbacks) or [ProgBarLogger(log_freq, verbose)]
@@ -123,6 +123,11 @@ class Model:
                 if num_iters is not None and it >= num_iters:
                     self.stop_training = True
                     break
+            if not epoch_losses:
+                raise ValueError(
+                    "fit() produced no batches — dataset smaller than "
+                    "batch_size with drop_last=True?"
+                )
             epoch_log = {"loss": float(np.mean(epoch_losses))}
             history["loss"].append(epoch_log["loss"])
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:

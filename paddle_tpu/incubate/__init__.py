@@ -1,0 +1,6 @@
+"""paddle.incubate analogue — LLM fused building blocks + MoE (ref:
+python/paddle/incubate/nn/functional/*, incubate/distributed/models/moe)."""
+from . import nn
+from .moe import MoELayer, SwiGLUExperts, TopKGate
+
+__all__ = ["nn", "MoELayer", "TopKGate", "SwiGLUExperts"]

@@ -1,0 +1,152 @@
+"""Mixture-of-Experts with expert parallelism.
+
+ref: python/paddle/incubate/distributed/models/moe/moe_layer.py:263
+(MoELayer + gshard/switch gates over global_scatter/global_gather a2a
+ops) and phi/kernels/fusion/cutlass/fused_moe_kernel.cu.
+
+TPU-first re-design: instead of materialized all-to-all scatter/gather
+ops, routing uses the GShard dense-dispatch einsum formulation —
+dispatch/combine tensors contracted against stacked expert weights
+[E, ...]. Under GSPMD, sharding the expert dim E over the 'ep' mesh axis
+turns those einsums into exactly the a2a dispatch/combine collectives the
+reference launches by hand, and the expert FFN becomes a grouped GEMM on
+each chip's local experts.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops as F
+from ..nn.layer.layers import Layer
+from ..nn.parameter import ParamAttr
+
+__all__ = ["TopKGate", "MoELayer", "SwiGLUExperts"]
+
+
+class TopKGate(Layer):
+    """Softmax top-k router (ref moe/gate/gshard_gate.py, switch_gate.py).
+    Returns (dispatch [s,e,c], combine [s,e,c], aux_loss)."""
+
+    def __init__(self, d_model, num_experts, k=2, capacity_factor=1.25):
+        super().__init__()
+        self.num_experts = num_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+        from ..nn import initializer as I
+
+        self.weight = self.create_parameter(
+            shape=[d_model, num_experts],
+            attr=ParamAttr(initializer=I.XavierUniform()),
+        )
+
+    def capacity(self, num_tokens):
+        return int(
+            np.ceil(self.k * num_tokens / self.num_experts
+                    * self.capacity_factor)
+        )
+
+    def forward(self, x):
+        """x: [s, m] flattened tokens."""
+        s, m = x.shape
+        e = self.num_experts
+        c = self.capacity(s)
+        logits = F.matmul(x, self.weight)          # [s, e]
+        gates = F.softmax(logits, -1)
+
+        # top-k expert choice per token (iterative masking keeps the
+        # whole routing jit-traceable: no dynamic shapes)
+        remaining = gates
+        dispatch_parts = []
+        combine_parts = []
+        # position counters per expert, built via cumsum of assignments
+        occupancy = None
+        for _ in range(self.k):
+            idx = F.argmax(remaining, -1)          # [s]
+            onehot = F.one_hot(idx, e)             # [s, e]
+            # position of each token within its chosen expert's buffer
+            prev = occupancy if occupancy is not None else None
+            running = F.cumsum(onehot, 0) - onehot  # exclusive prefix count
+            pos = running if prev is None else running + prev
+            occupancy = (
+                F.sum(onehot, 0, keepdim=True) + (
+                    occupancy if occupancy is not None else 0.0
+                )
+            )
+            in_cap = F.cast(pos < float(c), "float32") * onehot
+            posc = F.cast(F.sum(pos * onehot, -1), "int32")  # [s]
+            pos_onehot = F.one_hot(F.minimum(
+                posc, F.full_like(posc, c - 1)
+            ), c)                                   # [s, c]
+            part = in_cap.unsqueeze(-1) * pos_onehot.unsqueeze(1)  # [s,e,c]
+            gate_k = F.sum(gates * onehot, -1, keepdim=True)       # [s,1]
+            dispatch_parts.append(part)
+            combine_parts.append(part * gate_k.unsqueeze(-1))
+            remaining = remaining * (1.0 - onehot)
+
+        dispatch = dispatch_parts[0]
+        combine = combine_parts[0]
+        for dp, cp in zip(dispatch_parts[1:], combine_parts[1:]):
+            dispatch = dispatch + dp
+            combine = combine + cp
+
+        # renormalize combine over selected experts (Mixtral convention)
+        denom = F.sum(combine, [1, 2], keepdim=True) + 1e-9
+        combine = combine / denom
+
+        # GShard aux load-balancing loss: e * sum(mean_gate * mean_assign)
+        me = F.mean(gates, 0)                      # [e]
+        ce = F.mean(F.sum(dispatch, 2), 0)         # [e] fraction routed
+        aux = F.sum(me * ce) * float(e)
+        return dispatch, combine, aux
+
+
+class SwiGLUExperts(Layer):
+    """Stacked expert FFNs [E, ...] — one grouped GEMM per projection
+    (ref fused_moe_kernel.cu's grouped cutlass GEMMs)."""
+
+    def __init__(self, num_experts, d_model, d_ff):
+        super().__init__()
+        from ..nn import initializer as I
+
+        def mk(shape):
+            return self.create_parameter(
+                shape=shape, attr=ParamAttr(initializer=I.XavierUniform())
+            )
+
+        self.w_gate = mk([num_experts, d_model, d_ff])
+        self.w_up = mk([num_experts, d_model, d_ff])
+        self.w_down = mk([num_experts, d_ff, d_model])
+
+    def forward(self, dispatched):
+        """dispatched: [e, c, m] -> [e, c, m]."""
+        g = F.einsum("ecm,emf->ecf", dispatched, self.w_gate)
+        u = F.einsum("ecm,emf->ecf", dispatched, self.w_up)
+        h = F.swiglu(g, u)
+        return F.einsum("ecf,efm->ecm", h, self.w_down)
+
+
+class MoELayer(Layer):
+    """ref: incubate moe_layer.py:263. forward: [b, s, m] -> ([b, s, m],
+    aux_loss). Shard the expert dim of the three expert weights over an
+    'ep' mesh axis (Shard(0)) for expert parallelism — GSPMD inserts the
+    dispatch/combine all-to-alls."""
+
+    def __init__(self, d_model, num_experts, d_ff=None, k=2,
+                 capacity_factor=1.25, gate=None, experts=None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.gate = gate or TopKGate(d_model, num_experts, k,
+                                     capacity_factor)
+        self.experts = experts or SwiGLUExperts(
+            num_experts, d_model, d_ff or 4 * d_model
+        )
+
+    def forward(self, x):
+        b, s, m = x.shape
+        flat = F.reshape(x, [b * s, m])
+        dispatch, combine, aux = self.gate(flat)
+        dispatched = F.einsum("sec,sm->ecm", dispatch, flat)
+        expert_out = self.experts(dispatched)
+        out = F.einsum("sec,ecm->sm", combine, expert_out)
+        return F.reshape(out, [b, s, m]), aux

@@ -1,0 +1,26 @@
+"""paddle.incubate.nn.functional — the fused transformer op set
+(ref: python/paddle/incubate/nn/functional/__init__.py: fused_rms_norm,
+fused_rotary_position_embedding, swiglu, fused_matmul_bias,
+fused_bias_act, fused_layer_norm). On TPU these are the XLA/Pallas-fused
+paths of the corresponding core ops."""
+from ....ops import (  # noqa: F401
+    fused_bias_act,
+    fused_linear,
+    fused_rotary_position_embedding,
+    rope_qk,
+    swiglu,
+)
+from ....ops import layer_norm as fused_layer_norm  # noqa: F401
+from ....ops import rms_norm as fused_rms_norm  # noqa: F401
+from ....ops import (  # noqa: F401
+    scaled_dot_product_attention as fused_dot_product_attention,
+)
+
+fused_matmul_bias = fused_linear
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm",
+    "fused_rotary_position_embedding", "rope_qk", "swiglu",
+    "fused_linear", "fused_matmul_bias", "fused_bias_act",
+    "fused_dot_product_attention",
+]

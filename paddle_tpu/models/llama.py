@@ -35,6 +35,9 @@ class LlamaConfig:
         rope_theta=10000.0,
         tie_word_embeddings=False,
         dtype="float32",
+        num_experts=0,
+        num_experts_per_tok=2,
+        router_aux_loss_coef=0.02,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -47,6 +50,10 @@ class LlamaConfig:
         self.rope_theta = rope_theta
         self.tie_word_embeddings = tie_word_embeddings
         self.dtype = dtype
+        # num_experts > 0 makes the MLP a Mixtral-style MoE (BASELINE #4)
+        self.num_experts = num_experts
+        self.num_experts_per_tok = num_experts_per_tok
+        self.router_aux_loss_coef = router_aux_loss_coef
 
     @classmethod
     def tiny(cls, **overrides):
@@ -132,7 +139,17 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = RMSNorm(
             config.hidden_size, epsilon=config.rms_norm_eps
         )
-        self.mlp = LlamaMLP(config)
+        self._moe = config.num_experts > 0
+        if self._moe:
+            from ..incubate.moe import MoELayer
+
+            self.mlp = MoELayer(
+                config.hidden_size, config.num_experts,
+                d_ff=config.intermediate_size,
+                k=config.num_experts_per_tok,
+            )
+        else:
+            self.mlp = LlamaMLP(config)
 
     def forward(self, hidden, attn_mask=None):
         residual = hidden
@@ -141,8 +158,13 @@ class LlamaDecoderLayer(Layer):
         hidden = residual + hidden
         residual = hidden
         hidden = self.post_attention_layernorm(hidden)
-        hidden = self.mlp(hidden)
-        return residual + hidden
+        aux = None
+        if self._moe:
+            hidden, aux = self.mlp(hidden)
+        else:
+            hidden = self.mlp(hidden)
+        out = residual + hidden
+        return (out, aux) if self._moe else out
 
 
 class LlamaModel(Layer):
@@ -157,9 +179,19 @@ class LlamaModel(Layer):
 
     def forward(self, input_ids, attn_mask=None):
         hidden = self.embed_tokens(input_ids)
+        aux_total = None
         for layer in self.layers:
-            hidden = layer(hidden, attn_mask)
-        return self.norm(hidden)
+            out = layer(hidden, attn_mask)
+            if isinstance(out, tuple):
+                hidden, aux = out
+                if aux is not None:
+                    aux_total = aux if aux_total is None else aux_total + aux
+            else:
+                hidden = out
+        hidden = self.norm(hidden)
+        if self.config.num_experts > 0:
+            return hidden, aux_total
+        return hidden
 
 
 class LlamaForCausalLM(Layer):
@@ -176,6 +208,9 @@ class LlamaForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None):
         hidden = self.llama(input_ids)
+        aux = None
+        if isinstance(hidden, tuple):
+            hidden, aux = hidden
         if self.lm_head is not None:
             logits = self.lm_head(hidden)
         else:
@@ -190,6 +225,8 @@ class LlamaForCausalLM(Layer):
             F.reshape(logits[:, :-1], [-1, v]),
             F.reshape(labels[:, 1:], [-1]),
         )
+        if aux is not None:
+            loss = loss + self.config.router_aux_loss_coef * aux
         return logits, loss
 
     def num_params(self):

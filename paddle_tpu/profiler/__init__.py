@@ -164,6 +164,8 @@ class Profiler:
     def stop(self):
         if self._tracing:
             self._stop_trace()
+            if self._on_trace_ready is not None:
+                self._on_trace_ready(self)
         self.current_state = ProfilerState.CLOSED
 
     def step(self, num_samples=None):
