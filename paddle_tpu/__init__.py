@@ -53,6 +53,7 @@ from . import autograd  # noqa: E402
 from . import framework  # noqa: E402
 from . import device  # noqa: E402
 from . import distributed  # noqa: E402
+from . import distribution  # noqa: E402
 from . import hapi  # noqa: E402
 from . import incubate  # noqa: E402
 from .hapi import Model  # noqa: E402

@@ -4,6 +4,7 @@ Wires the DistTensor dispatch hook into core.dispatch at import time (the
 analogue of the generated dist branch in every ad_func).
 """
 from ..core import dispatch as _dispatch
+from . import checkpoint  # noqa: F401
 from .communication import (
     Group,
     ReduceOp,
@@ -39,7 +40,9 @@ from .parallel import (
     shard_layer,
     shard_optimizer,
 )
+from .pipeline import PipelineStages, pipeline_apply
 from .placement import Partial, Placement, Replicate, Shard
+from .sequence_parallel import gather_sequence, ring_attention, split_sequence
 from .process_mesh import ProcessMesh
 
 _dispatch.set_dist_hook(_dist_dispatch)
@@ -51,6 +54,9 @@ __all__ = [
     "Group", "ReduceOp", "new_group", "get_group", "destroy_process_group",
     "all_reduce", "all_gather", "all_to_all", "broadcast", "reduce",
     "reduce_scatter", "scatter", "barrier",
+    "ring_attention", "split_sequence", "gather_sequence",
+    "pipeline_apply", "PipelineStages",
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
     "DataParallel", "shard_layer", "shard_optimizer", "default_mesh",
+    "checkpoint",
 ]

@@ -1,0 +1,132 @@
+"""Process launcher (ref: python/paddle/distributed/launch/main.py:23
+launch(); controllers/collective.py:37 build_pod; env contract set at
+collective.py:76-132).
+
+TPU-native shape: jax is single-controller per HOST (one process drives
+all local chips), so the per-GPU-process fan-out the reference performs
+collapses to one worker per node; multi-node rendezvous goes through the
+jax coordination service (PADDLE_MASTER -> coordinator_address) instead
+of TCPStore. The reference's env contract is preserved so existing
+`paddle.distributed.launch`-style scripts keep working:
+
+    python -m paddle_tpu.distributed.launch --nnodes=2 \
+        --master=10.0.0.1:8090 --rank=0 train.py --my-args
+
+Workers read PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER
+(ParallelEnv, distributed/parallel.py) and call
+paddle.distributed.init_parallel_env().
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch"]
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch distributed training workers",
+    )
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of nodes (hosts)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="worker processes per node (TPU: 1 process "
+                        "drives all local chips)")
+    p.add_argument("--master", type=str, default=None,
+                   help="coordinator host:port (node rank 0)")
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", 0)),
+                   help="this node's rank")
+    p.add_argument("--log_dir", type=str, default="log",
+                   help="per-worker log directory")
+    p.add_argument("--devices", type=str, default=None,
+                   help="visible device ids (comma separated)")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _worker_env(args, local_rank):
+    env = dict(os.environ)
+    world = args.nnodes * args.nproc_per_node
+    rank = args.rank * args.nproc_per_node + local_rank
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_NNODES": str(args.nnodes),
+    })
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+        # jax.distributed.initialize reads these directly
+        env.setdefault("JAX_COORDINATOR_ADDRESS", args.master)
+        env.setdefault("JAX_NUM_PROCESSES", str(world))
+        env.setdefault("JAX_PROCESS_ID", str(rank))
+    if args.devices:
+        env["TPU_VISIBLE_DEVICES"] = args.devices
+    return env
+
+
+def launch(argv=None):
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    os.makedirs(args.log_dir, exist_ok=True)
+
+    procs = []
+    for local_rank in range(args.nproc_per_node):
+        rank = args.rank * args.nproc_per_node + local_rank
+        log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
+        log_f = open(log_path, "w")
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        proc = subprocess.Popen(
+            cmd, env=_worker_env(args, local_rank),
+            stdout=log_f, stderr=subprocess.STDOUT,
+        )
+        procs.append((proc, log_f, log_path))
+        print(f"launched worker rank={rank} pid={proc.pid} "
+              f"log={log_path}", file=sys.stderr)
+
+    # Pod supervision (ref controllers/watcher.py): fail fast if any
+    # worker dies nonzero, terminate the rest.
+    exit_code = 0
+    try:
+        while procs:
+            alive = []
+            for proc, log_f, log_path in procs:
+                ret = proc.poll()
+                if ret is None:
+                    alive.append((proc, log_f, log_path))
+                    continue
+                log_f.close()
+                if ret != 0:
+                    print(
+                        f"worker pid={proc.pid} exited {ret}; see "
+                        f"{log_path} — terminating pod",
+                        file=sys.stderr,
+                    )
+                    exit_code = ret
+                    for other, f2, _ in alive + procs:
+                        if other.poll() is None:
+                            other.send_signal(signal.SIGTERM)
+                    procs = []
+                    alive = []
+                    break
+            procs = alive
+            if procs:
+                time.sleep(0.2)
+    except KeyboardInterrupt:
+        for proc, _, _ in procs:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        exit_code = 130
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -45,14 +45,17 @@ def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
 
     # mark the carries device-varying (they merge with per-device k/v in
     # the scan; see shard_map vma semantics)
-    m0 = jax.lax.pvary(
-        jnp.full((b, h, s_loc, 1), -1e30, jnp.float32), (axis_name,)
+    m0 = jax.lax.pcast(
+        jnp.full((b, h, s_loc, 1), -1e30, jnp.float32), (axis_name,),
+        to="varying",
     )
-    l0 = jax.lax.pvary(
-        jnp.zeros((b, h, s_loc, 1), jnp.float32), (axis_name,)
+    l0 = jax.lax.pcast(
+        jnp.zeros((b, h, s_loc, 1), jnp.float32), (axis_name,),
+        to="varying",
     )
-    acc0 = jax.lax.pvary(
-        jnp.zeros((b, h, s_loc, d), jnp.float32), (axis_name,)
+    acc0 = jax.lax.pcast(
+        jnp.zeros((b, h, s_loc, d), jnp.float32), (axis_name,),
+        to="varying",
     )
 
     def step(carry, i):

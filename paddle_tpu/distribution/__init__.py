@@ -1,0 +1,30 @@
+"""Probability distributions (ref: python/paddle/distribution/ — ~25
+classes over a Distribution base with sample/log_prob/entropy/kl_divergence;
+tested against scipy in test/distribution).
+
+TPU-first: sampling draws keys from the framework RNG at wrapper level and
+runs jnp math (traceable under jit); math accumulates in the input dtype.
+"""
+from .distributions import (
+    Bernoulli,
+    Beta,
+    Categorical,
+    Dirichlet,
+    Distribution,
+    Exponential,
+    Gamma,
+    Gumbel,
+    Laplace,
+    LogNormal,
+    Multinomial,
+    Normal,
+    Uniform,
+    kl_divergence,
+    register_kl,
+)
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+    "Exponential", "Laplace", "LogNormal", "Gumbel", "Beta", "Gamma",
+    "Dirichlet", "Multinomial", "kl_divergence", "register_kl",
+]

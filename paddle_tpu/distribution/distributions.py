@@ -1,0 +1,528 @@
+"""Distribution implementations (ref: python/paddle/distribution/
+{distribution,normal,uniform,bernoulli,categorical,exponential,laplace,
+lognormal,gumbel,beta,gamma,dirichlet,multinomial}.py and
+kl.py's registry)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.random import split_key
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+    "Exponential", "Laplace", "LogNormal", "Gumbel", "Beta", "Gamma",
+    "Dirichlet", "Multinomial", "kl_divergence", "register_kl",
+]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32)
+
+
+def _wrap(a):
+    return Tensor(a, stop_gradient=True)
+
+
+def _shape_of(sample_shape, *params):
+    base = jnp.broadcast_shapes(*[jnp.shape(p) for p in params])
+    return tuple(sample_shape) + base
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(_arr(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale)
+        ))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(
+            jnp.square(self.scale), self._batch_shape
+        ))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(self.scale, self._batch_shape))
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.loc, self.scale)
+        eps = jax.random.normal(split_key(), shp)
+        return _wrap(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        var = jnp.square(self.scale)
+        return _wrap(
+            -jnp.square(v - self.loc) / (2 * var)
+            - jnp.log(self.scale)
+            - 0.5 * math.log(2 * math.pi)
+        )
+
+    def entropy(self):
+        return _wrap(
+            0.5 + 0.5 * math.log(2 * math.pi)
+            + jnp.log(jnp.broadcast_to(self.scale, self._batch_shape))
+        )
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.low), jnp.shape(self.high)
+        ))
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.low, self.high)
+        u = jax.random.uniform(split_key(), shp)
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        inside = jnp.logical_and(v >= self.low, v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _wrap(jnp.log(self.high - self.low))
+
+    @property
+    def mean(self):
+        return _wrap((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return _wrap(jnp.square(self.high - self.low) / 12)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _arr(probs)
+        super().__init__(jnp.shape(self.probs))
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.probs)
+        return _wrap(
+            jax.random.bernoulli(split_key(), self.probs, shp).astype(
+                jnp.float32
+            )
+        )
+
+    def log_prob(self, value):
+        v = _arr(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _wrap(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+    @property
+    def mean(self):
+        return _wrap(self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs * (1 - self.probs))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("provide logits or probs")
+        if logits is not None:
+            self.logits = _arr(logits)
+        else:
+            self.logits = jnp.log(jnp.clip(_arr(probs), 1e-12, None))
+        super().__init__(jnp.shape(self.logits)[:-1])
+
+    @property
+    def probs(self):
+        return _wrap(jax.nn.softmax(self.logits, -1))
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(
+            split_key(), self.logits, shape=tuple(shape) + self._batch_shape
+        )
+        return _wrap(out.astype(jnp.int32))
+
+    def log_prob(self, value):
+        v = _arr(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, -1)
+        # broadcast a ()-batch distribution against a vector of values
+        logp_b = jnp.broadcast_to(logp, v.shape + logp.shape[-1:])
+        return _wrap(jnp.take_along_axis(
+            logp_b, v[..., None], axis=-1
+        )[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        p = jnp.exp(logp)
+        return _wrap(-jnp.sum(p * logp, -1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(jnp.shape(self.rate))
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.rate)
+        return _wrap(jax.random.exponential(split_key(), shp) / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _wrap(1.0 - jnp.log(self.rate))
+
+    @property
+    def mean(self):
+        return _wrap(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(1.0 / jnp.square(self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale)
+        ))
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.loc, self.scale)
+        return _wrap(self.loc + self.scale * jax.random.laplace(
+            split_key(), shp
+        ))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(
+            -jnp.abs(v - self.loc) / self.scale
+            - jnp.log(2 * self.scale)
+        )
+
+    def entropy(self):
+        return _wrap(1 + jnp.log(2 * self.scale))
+
+    @property
+    def mean(self):
+        return _wrap(self.loc)
+
+    @property
+    def variance(self):
+        return _wrap(2 * jnp.square(self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(self._normal._batch_shape)
+
+    def sample(self, shape=()):
+        return _wrap(jnp.exp(_arr(self._normal.sample(shape))))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        return _wrap(
+            _arr(self._normal.log_prob(jnp.log(v))) - jnp.log(v)
+        )
+
+    @property
+    def mean(self):
+        return _wrap(jnp.exp(self.loc + jnp.square(self.scale) / 2))
+
+    @property
+    def variance(self):
+        s2 = jnp.square(self.scale)
+        return _wrap((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def entropy(self):
+        return _wrap(_arr(self._normal.entropy()) + self.loc)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale)
+        ))
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.loc, self.scale)
+        return _wrap(self.loc + self.scale * jax.random.gumbel(
+            split_key(), shp
+        ))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_arr(value) - self.loc) / self.scale
+        return _wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    @property
+    def mean(self):
+        return _wrap(self.loc + self.scale * np.euler_gamma)
+
+    @property
+    def variance(self):
+        return _wrap(jnp.square(self.scale) * (math.pi ** 2) / 6)
+
+    def entropy(self):
+        return _wrap(jnp.log(self.scale) + 1 + np.euler_gamma)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.concentration), jnp.shape(self.rate)
+        ))
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.concentration, self.rate)
+        g = jax.random.gamma(split_key(), jnp.broadcast_to(
+            self.concentration, shp
+        ))
+        return _wrap(g / self.rate)
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.concentration, self.rate
+        return _wrap(
+            a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+            - jax.scipy.special.gammaln(a)
+        )
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.concentration / jnp.square(self.rate))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return _wrap(
+            a - jnp.log(b) + jax.scipy.special.gammaln(a)
+            + (1 - a) * jax.scipy.special.digamma(a)
+        )
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.alpha), jnp.shape(self.beta)
+        ))
+
+    def sample(self, shape=()):
+        shp = _shape_of(shape, self.alpha, self.beta)
+        return _wrap(jax.random.beta(
+            split_key(),
+            jnp.broadcast_to(self.alpha, shp),
+            jnp.broadcast_to(self.beta, shp),
+        ))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a, b = self.alpha, self.beta
+        lbeta = (
+            jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+            - jax.scipy.special.gammaln(a + b)
+        )
+        return _wrap((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta)
+
+    @property
+    def mean(self):
+        return _wrap(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _wrap(self.alpha * self.beta / (jnp.square(s) * (s + 1)))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _arr(concentration)
+        super().__init__(
+            jnp.shape(self.concentration)[:-1],
+            jnp.shape(self.concentration)[-1:],
+        )
+
+    def sample(self, shape=()):
+        return _wrap(jax.random.dirichlet(
+            split_key(), self.concentration,
+            tuple(shape) + self._batch_shape,
+        ))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        a = self.concentration
+        lnorm = jnp.sum(jax.scipy.special.gammaln(a), -1) - (
+            jax.scipy.special.gammaln(jnp.sum(a, -1))
+        )
+        return _wrap(jnp.sum((a - 1) * jnp.log(v), -1) - lnorm)
+
+    @property
+    def mean(self):
+        return _wrap(
+            self.concentration
+            / jnp.sum(self.concentration, -1, keepdims=True)
+        )
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_arr = _arr(probs)
+        super().__init__(
+            jnp.shape(self.probs_arr)[:-1], jnp.shape(self.probs_arr)[-1:]
+        )
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.clip(self.probs_arr, 1e-12, None))
+        draws = jax.random.categorical(
+            split_key(), logits,
+            shape=tuple(shape) + (self.total_count,) + self._batch_shape,
+        )
+        k = self.probs_arr.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        axis = len(tuple(shape))
+        return _wrap(jnp.sum(onehot, axis=axis))
+
+    def log_prob(self, value):
+        v = _arr(value)
+        logp = jnp.log(jnp.clip(self.probs_arr, 1e-12, None))
+        coeff = (
+            jax.scipy.special.gammaln(jnp.asarray(self.total_count + 1.0))
+            - jnp.sum(jax.scipy.special.gammaln(v + 1.0), -1)
+        )
+        return _wrap(coeff + jnp.sum(v * logp, -1))
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs_arr)
+
+
+# ---- KL registry (ref: distribution/kl.py register_kl) -------------------
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})"
+    )
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = jnp.square(p.scale / q.scale)
+    t1 = jnp.square((p.loc - q.loc) / q.scale)
+    return _wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return _wrap(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return _wrap(
+        pp * (jnp.log(pp) - jnp.log(qq))
+        + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq))
+    )
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    logp = jax.nn.log_softmax(p.logits, -1)
+    logq = jax.nn.log_softmax(q.logits, -1)
+    return _wrap(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return _wrap(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
