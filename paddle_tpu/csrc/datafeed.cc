@@ -1,0 +1,110 @@
+// Native host data-feed kernels.
+//
+// ref: the reference's C++ data pipeline (paddle/fluid/framework/
+// data_feed.cc, data_set.cc and the DataLoader C core
+// paddle/fluid/imperative/data_loader.cc) — multi-threaded batch assembly
+// feeding the device. The TPU build keeps the Python DataLoader
+// orchestration (io/dataloader.py) and moves the per-batch hot loop —
+// gather rows by index, uint8->float32 conversion, per-channel
+// normalization, HWC->CHW transpose — into this C++ library, called
+// through ctypes (no pybind available in this image).
+//
+// Build: g++ -O3 -march=native -shared -fPIC datafeed.cc -o libdatafeed.so
+// (driven by paddle_tpu/io/native.py at first use, cached beside this file).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Gather + normalize + transpose a batch of uint8 HWC images into a
+// float32 NCHW tensor: out[b,c,y,x] = (src[idx[b],y,x,c]/255 - mean[c]) / std[c]
+void ptpu_collate_images_u8_nchw(
+    const uint8_t* src, const int64_t* indices, int64_t batch,
+    int64_t h, int64_t w, int64_t c,
+    const float* mean, const float* stddev,
+    float* out, int threads) {
+  const int64_t img = h * w * c;
+  const int64_t plane = h * w;
+  std::vector<float> scale(c), bias(c);
+  for (int64_t ch = 0; ch < c; ++ch) {
+    scale[ch] = 1.0f / (255.0f * stddev[ch]);
+    bias[ch] = -mean[ch] / stddev[ch];
+  }
+  auto worker = [&](int64_t b0, int64_t b1) {
+    for (int64_t b = b0; b < b1; ++b) {
+      const uint8_t* im = src + indices[b] * img;
+      float* ob = out + b * img;
+      for (int64_t ch = 0; ch < c; ++ch) {
+        const float s = scale[ch], bi = bias[ch];
+        float* oc = ob + ch * plane;
+        const uint8_t* ic = im + ch;
+        for (int64_t p = 0; p < plane; ++p) {
+          oc[p] = static_cast<float>(ic[p * c]) * s + bi;
+        }
+      }
+    }
+  };
+  if (threads <= 1 || batch < 4) {
+    worker(0, batch);
+    return;
+  }
+  const int nt = threads > batch ? static_cast<int>(batch) : threads;
+  std::vector<std::thread> pool;
+  const int64_t step = (batch + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    const int64_t b0 = t * step;
+    const int64_t b1 = b0 + step > batch ? batch : b0 + step;
+    if (b0 >= b1) break;
+    pool.emplace_back(worker, b0, b1);
+  }
+  for (auto& th : pool) th.join();
+}
+
+// Gather rows of a float32 matrix by index: out[b, :] = src[idx[b], :]
+void ptpu_gather_rows_f32(
+    const float* src, const int64_t* indices, int64_t batch,
+    int64_t row_elems, float* out, int threads) {
+  auto worker = [&](int64_t b0, int64_t b1) {
+    for (int64_t b = b0; b < b1; ++b) {
+      std::memcpy(out + b * row_elems, src + indices[b] * row_elems,
+                  sizeof(float) * row_elems);
+    }
+  };
+  if (threads <= 1 || batch < 64) {
+    worker(0, batch);
+    return;
+  }
+  const int nt = threads > batch ? static_cast<int>(batch) : threads;
+  std::vector<std::thread> pool;
+  const int64_t step = (batch + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    const int64_t b0 = t * step;
+    const int64_t b1 = b0 + step > batch ? batch : b0 + step;
+    if (b0 >= b1) break;
+    pool.emplace_back(worker, b0, b1);
+  }
+  for (auto& th : pool) th.join();
+}
+
+// Token-stream batcher: pack a ragged corpus (concatenated token ids +
+// offsets) into fixed [batch, seq_len] int32 blocks starting at the
+// given cursor positions (the LLM pretraining feed).
+void ptpu_pack_tokens_i32(
+    const int32_t* corpus, int64_t corpus_len,
+    const int64_t* starts, int64_t batch, int64_t seq_len,
+    int32_t pad_id, int32_t* out) {
+  for (int64_t b = 0; b < batch; ++b) {
+    const int64_t s = starts[b];
+    for (int64_t t = 0; t < seq_len; ++t) {
+      const int64_t pos = s + t;
+      out[b * seq_len + t] =
+          pos < corpus_len ? corpus[pos] : pad_id;
+    }
+  }
+}
+
+}  // extern "C"

@@ -23,7 +23,6 @@ import numpy as np
 from ..core.tensor import Tensor
 from .dist_tensor import shard_tensor, to_global_array
 from .placement import Partial, Replicate, Shard
-from .process_mesh import ProcessMesh
 
 __all__ = ["save_state_dict", "load_state_dict"]
 
@@ -74,9 +73,9 @@ def save_state_dict(state_dict, path, process_group=None,
                     "shape": list(arr.shape),
                 }
             if arr.dtype.name == "bfloat16":
+                # npz cannot hold bf16; stored widened, dtype key restores
                 meta["tensors"][key]["dtype"] = "bfloat16"
                 arr = arr.astype(np.float32)
-                meta["tensors"][key]["stored_dtype"] = "float32"
             arrays[key] = arr
         elif isinstance(value, np.ndarray):
             meta["tensors"][key] = {
@@ -95,8 +94,24 @@ def save_state_dict(state_dict, path, process_group=None,
     pyvals = {
         k: v for k, v in arrays.items() if not isinstance(v, np.ndarray)
     }
+    def _json_default(v):
+        # numpy scalars degrade losslessly; anything else is an error —
+        # silent str() corruption is worse than failing the save
+        if isinstance(v, np.integer):
+            return int(v)
+        if isinstance(v, (np.floating, np.bool_)):
+            return v.item()
+        raise TypeError(
+            f"state_dict value of type {type(v).__name__} is not "
+            "checkpointable; convert it to a Tensor, ndarray, or plain "
+            "python value"
+        )
+
     with open(os.path.join(path, _META_FILE), "w") as f:
-        json.dump({"meta": meta, "python_values": pyvals}, f, default=str)
+        json.dump(
+            {"meta": meta, "python_values": pyvals}, f,
+            default=_json_default,
+        )
 
 
 def load_state_dict(state_dict, path, process_group=None,

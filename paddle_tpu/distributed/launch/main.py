@@ -111,9 +111,8 @@ def launch(argv=None):
                         file=sys.stderr,
                     )
                     exit_code = ret
-                    for other, f2, _ in alive + procs:
-                        if other.poll() is None:
-                            other.send_signal(signal.SIGTERM)
+                    _terminate(alive + procs[procs.index((proc, log_f,
+                                                          log_path)) + 1:])
                     procs = []
                     alive = []
                     break
@@ -121,11 +120,30 @@ def launch(argv=None):
             if procs:
                 time.sleep(0.2)
     except KeyboardInterrupt:
-        for proc, _, _ in procs:
-            if proc.poll() is None:
-                proc.send_signal(signal.SIGTERM)
+        _terminate(procs)
         exit_code = 130
     return exit_code
+
+
+def _terminate(procs, grace=5.0):
+    """SIGTERM the pod, wait out the grace period, SIGKILL stragglers,
+    and close log handles (workers must not outlive the launcher and keep
+    the TPU locked for the next job)."""
+    for proc, _, _ in procs:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+    deadline = time.time() + grace
+    for proc, log_f, _ in procs:
+        remaining = max(0.1, deadline - time.time())
+        try:
+            proc.wait(timeout=remaining)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        try:
+            log_f.close()
+        except Exception:
+            pass
 
 
 if __name__ == "__main__":

@@ -1,7 +1,13 @@
 """Distribution implementations (ref: python/paddle/distribution/
 {distribution,normal,uniform,bernoulli,categorical,exponential,laplace,
-lognormal,gumbel,beta,gamma,dirichlet,multinomial}.py and
-kl.py's registry)."""
+lognormal,gumbel,beta,gamma,dirichlet,multinomial}.py and kl.py's registry).
+
+Autograd contract: distribution parameters may be Tensors with
+stop_gradient=False; log_prob / entropy / rsample / kl_divergence are
+recorded on the tape w.r.t. those parameters (the VAE / policy-gradient
+path). `_traced` routes the math through core.dispatch so jax.vjp supplies
+the backward; with no grad-requiring inputs it evaluates detached.
+"""
 from __future__ import annotations
 
 import math
@@ -31,8 +37,29 @@ def _wrap(a):
     return Tensor(a, stop_gradient=True)
 
 
+def _traced(name, fn, *args):
+    """Evaluate fn over (Tensor|array) args; recorded on the autograd tape
+    when any Tensor input requires grad."""
+    from ..core import autograd, dispatch
+
+    tensor_args = tuple(a for a in args if isinstance(a, Tensor))
+    needs = autograd.is_grad_enabled() and any(
+        not t.stop_gradient for t in tensor_args
+    )
+    if not needs:
+        arrs = [_arr(a) if isinstance(a, Tensor) else a for a in args]
+        return Tensor(fn(*arrs), stop_gradient=True)
+
+    def impl(*tarrs):
+        it = iter(tarrs)
+        full = [next(it) if isinstance(a, Tensor) else a for a in args]
+        return fn(*full)
+
+    return dispatch.call(name, impl, tensor_args, {})
+
+
 def _shape_of(sample_shape, *params):
-    base = jnp.broadcast_shapes(*[jnp.shape(p) for p in params])
+    base = jnp.broadcast_shapes(*[jnp.shape(_arr(p)) for p in params])
     return tuple(sample_shape) + base
 
 
@@ -59,7 +86,9 @@ class Distribution:
         raise NotImplementedError
 
     def prob(self, value):
-        return _wrap(jnp.exp(_arr(self.log_prob(value))))
+        from .. import ops as F
+
+        return F.exp(self.log_prob(value))
 
     def entropy(self):
         raise NotImplementedError
@@ -70,6 +99,8 @@ class Distribution:
 
 class Normal(Distribution):
     def __init__(self, loc, scale, name=None):
+        self._loc = loc
+        self._scale = scale
         self.loc = _arr(loc)
         self.scale = _arr(scale)
         super().__init__(jnp.broadcast_shapes(
@@ -91,30 +122,40 @@ class Normal(Distribution):
         return _wrap(jnp.broadcast_to(self.scale, self._batch_shape))
 
     def sample(self, shape=()):
-        shp = _shape_of(shape, self.loc, self.scale)
-        eps = jax.random.normal(split_key(), shp)
-        return _wrap(self.loc + self.scale * eps)
+        eps = jax.random.normal(
+            split_key(), _shape_of(shape, self._loc, self._scale)
+        )
+        return _traced(
+            "normal_rsample", lambda l, s: l + s * eps,
+            self._loc, self._scale,
+        )
 
-    rsample = sample
+    rsample = sample  # reparameterized by construction
 
     def log_prob(self, value):
-        v = _arr(value)
-        var = jnp.square(self.scale)
-        return _wrap(
-            -jnp.square(v - self.loc) / (2 * var)
-            - jnp.log(self.scale)
-            - 0.5 * math.log(2 * math.pi)
+        return _traced(
+            "normal_log_prob",
+            lambda l, s, v: (
+                -jnp.square(v - l) / (2 * jnp.square(s))
+                - jnp.log(s) - 0.5 * math.log(2 * math.pi)
+            ),
+            self._loc, self._scale, value,
         )
 
     def entropy(self):
-        return _wrap(
-            0.5 + 0.5 * math.log(2 * math.pi)
-            + jnp.log(jnp.broadcast_to(self.scale, self._batch_shape))
+        bshape = self._batch_shape
+        return _traced(
+            "normal_entropy",
+            lambda s: jnp.broadcast_to(
+                0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s), bshape
+            ),
+            self._scale,
         )
 
 
 class Uniform(Distribution):
     def __init__(self, low, high, name=None):
+        self._low, self._high = low, high
         self.low = _arr(low)
         self.high = _arr(high)
         super().__init__(jnp.broadcast_shapes(
@@ -122,20 +163,31 @@ class Uniform(Distribution):
         ))
 
     def sample(self, shape=()):
-        shp = _shape_of(shape, self.low, self.high)
-        u = jax.random.uniform(split_key(), shp)
-        return _wrap(self.low + (self.high - self.low) * u)
+        u = jax.random.uniform(
+            split_key(), _shape_of(shape, self._low, self._high)
+        )
+        return _traced(
+            "uniform_rsample", lambda lo, hi: lo + (hi - lo) * u,
+            self._low, self._high,
+        )
 
     rsample = sample
 
     def log_prob(self, value):
-        v = _arr(value)
-        inside = jnp.logical_and(v >= self.low, v < self.high)
-        lp = -jnp.log(self.high - self.low)
-        return _wrap(jnp.where(inside, lp, -jnp.inf))
+        return _traced(
+            "uniform_log_prob",
+            lambda lo, hi, v: jnp.where(
+                jnp.logical_and(v >= lo, v < hi),
+                -jnp.log(hi - lo), -jnp.inf,
+            ),
+            self._low, self._high, value,
+        )
 
     def entropy(self):
-        return _wrap(jnp.log(self.high - self.low))
+        return _traced(
+            "uniform_entropy", lambda lo, hi: jnp.log(hi - lo),
+            self._low, self._high,
+        )
 
     @property
     def mean(self):
@@ -148,11 +200,12 @@ class Uniform(Distribution):
 
 class Bernoulli(Distribution):
     def __init__(self, probs, name=None):
+        self._probs = probs
         self.probs = _arr(probs)
         super().__init__(jnp.shape(self.probs))
 
     def sample(self, shape=()):
-        shp = _shape_of(shape, self.probs)
+        shp = _shape_of(shape, self._probs)
         return _wrap(
             jax.random.bernoulli(split_key(), self.probs, shp).astype(
                 jnp.float32
@@ -160,13 +213,26 @@ class Bernoulli(Distribution):
         )
 
     def log_prob(self, value):
-        v = _arr(value)
-        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
-        return _wrap(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+        return _traced(
+            "bernoulli_log_prob",
+            lambda p, v: (
+                v * jnp.log(jnp.clip(p, 1e-7, 1 - 1e-7))
+                + (1 - v) * jnp.log1p(-jnp.clip(p, 1e-7, 1 - 1e-7))
+            ),
+            self._probs, value,
+        )
 
     def entropy(self):
-        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
-        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+        return _traced(
+            "bernoulli_entropy",
+            lambda p: -(
+                jnp.clip(p, 1e-7, 1 - 1e-7)
+                * jnp.log(jnp.clip(p, 1e-7, 1 - 1e-7))
+                + (1 - jnp.clip(p, 1e-7, 1 - 1e-7))
+                * jnp.log1p(-jnp.clip(p, 1e-7, 1 - 1e-7))
+            ),
+            self._probs,
+        )
 
     @property
     def mean(self):
@@ -182,8 +248,11 @@ class Categorical(Distribution):
         if logits is None and probs is None:
             raise ValueError("provide logits or probs")
         if logits is not None:
+            self._logits = logits
             self.logits = _arr(logits)
         else:
+            self._logits = None
+            self._probs_in = probs
             self.logits = jnp.log(jnp.clip(_arr(probs), 1e-12, None))
         super().__init__(jnp.shape(self.logits)[:-1])
 
@@ -198,37 +267,65 @@ class Categorical(Distribution):
         return _wrap(out.astype(jnp.int32))
 
     def log_prob(self, value):
-        v = _arr(value).astype(jnp.int32)
-        logp = jax.nn.log_softmax(self.logits, -1)
-        # broadcast a ()-batch distribution against a vector of values
-        logp_b = jnp.broadcast_to(logp, v.shape + logp.shape[-1:])
-        return _wrap(jnp.take_along_axis(
-            logp_b, v[..., None], axis=-1
-        )[..., 0])
+        src = self._logits if self._logits is not None else self._probs_in
+
+        def fn(param, v):
+            logits = (
+                param if self._logits is not None
+                else jnp.log(jnp.clip(param, 1e-12, None))
+            )
+            logp = jax.nn.log_softmax(logits, -1)
+            vi = v.astype(jnp.int32)
+            # standard broadcasting: value broadcasts against batch shape
+            out_shape = jnp.broadcast_shapes(
+                jnp.shape(vi), logp.shape[:-1]
+            )
+            vi = jnp.broadcast_to(vi, out_shape)
+            logp_b = jnp.broadcast_to(logp, out_shape + logp.shape[-1:])
+            return jnp.take_along_axis(
+                logp_b, vi[..., None], axis=-1
+            )[..., 0]
+
+        return _traced("categorical_log_prob", fn, src, _arr(value))
 
     def entropy(self):
-        logp = jax.nn.log_softmax(self.logits, -1)
-        p = jnp.exp(logp)
-        return _wrap(-jnp.sum(p * logp, -1))
+        src = self._logits if self._logits is not None else self._probs_in
+
+        def fn(param):
+            logits = (
+                param if self._logits is not None
+                else jnp.log(jnp.clip(param, 1e-12, None))
+            )
+            logp = jax.nn.log_softmax(logits, -1)
+            return -jnp.sum(jnp.exp(logp) * logp, -1)
+
+        return _traced("categorical_entropy", fn, src)
 
 
 class Exponential(Distribution):
     def __init__(self, rate, name=None):
+        self._rate = rate
         self.rate = _arr(rate)
         super().__init__(jnp.shape(self.rate))
 
     def sample(self, shape=()):
-        shp = _shape_of(shape, self.rate)
-        return _wrap(jax.random.exponential(split_key(), shp) / self.rate)
+        e = jax.random.exponential(
+            split_key(), _shape_of(shape, self._rate)
+        )
+        return _traced("exponential_rsample", lambda r: e / r, self._rate)
 
     rsample = sample
 
     def log_prob(self, value):
-        v = _arr(value)
-        return _wrap(jnp.log(self.rate) - self.rate * v)
+        return _traced(
+            "exponential_log_prob",
+            lambda r, v: jnp.log(r) - r * v, self._rate, value,
+        )
 
     def entropy(self):
-        return _wrap(1.0 - jnp.log(self.rate))
+        return _traced(
+            "exponential_entropy", lambda r: 1.0 - jnp.log(r), self._rate
+        )
 
     @property
     def mean(self):
@@ -241,6 +338,7 @@ class Exponential(Distribution):
 
 class Laplace(Distribution):
     def __init__(self, loc, scale, name=None):
+        self._loc, self._scale = loc, scale
         self.loc = _arr(loc)
         self.scale = _arr(scale)
         super().__init__(jnp.broadcast_shapes(
@@ -248,22 +346,27 @@ class Laplace(Distribution):
         ))
 
     def sample(self, shape=()):
-        shp = _shape_of(shape, self.loc, self.scale)
-        return _wrap(self.loc + self.scale * jax.random.laplace(
-            split_key(), shp
-        ))
+        eps = jax.random.laplace(
+            split_key(), _shape_of(shape, self._loc, self._scale)
+        )
+        return _traced(
+            "laplace_rsample", lambda l, s: l + s * eps,
+            self._loc, self._scale,
+        )
 
     rsample = sample
 
     def log_prob(self, value):
-        v = _arr(value)
-        return _wrap(
-            -jnp.abs(v - self.loc) / self.scale
-            - jnp.log(2 * self.scale)
+        return _traced(
+            "laplace_log_prob",
+            lambda l, s, v: -jnp.abs(v - l) / s - jnp.log(2 * s),
+            self._loc, self._scale, value,
         )
 
     def entropy(self):
-        return _wrap(1 + jnp.log(2 * self.scale))
+        return _traced(
+            "laplace_entropy", lambda s: 1 + jnp.log(2 * s), self._scale
+        )
 
     @property
     def mean(self):
@@ -276,18 +379,32 @@ class Laplace(Distribution):
 
 class LogNormal(Distribution):
     def __init__(self, loc, scale, name=None):
+        self._loc, self._scale = loc, scale
         self.loc = _arr(loc)
         self.scale = _arr(scale)
-        self._normal = Normal(loc, scale)
-        super().__init__(self._normal._batch_shape)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale)
+        ))
 
     def sample(self, shape=()):
-        return _wrap(jnp.exp(_arr(self._normal.sample(shape))))
+        eps = jax.random.normal(
+            split_key(), _shape_of(shape, self._loc, self._scale)
+        )
+        return _traced(
+            "lognormal_rsample", lambda l, s: jnp.exp(l + s * eps),
+            self._loc, self._scale,
+        )
+
+    rsample = sample
 
     def log_prob(self, value):
-        v = _arr(value)
-        return _wrap(
-            _arr(self._normal.log_prob(jnp.log(v))) - jnp.log(v)
+        return _traced(
+            "lognormal_log_prob",
+            lambda l, s, v: (
+                -jnp.square(jnp.log(v) - l) / (2 * jnp.square(s))
+                - jnp.log(s) - 0.5 * math.log(2 * math.pi) - jnp.log(v)
+            ),
+            self._loc, self._scale, value,
         )
 
     @property
@@ -300,11 +417,16 @@ class LogNormal(Distribution):
         return _wrap((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
 
     def entropy(self):
-        return _wrap(_arr(self._normal.entropy()) + self.loc)
+        return _traced(
+            "lognormal_entropy",
+            lambda l, s: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s) + l,
+            self._loc, self._scale,
+        )
 
 
 class Gumbel(Distribution):
     def __init__(self, loc, scale, name=None):
+        self._loc, self._scale = loc, scale
         self.loc = _arr(loc)
         self.scale = _arr(scale)
         super().__init__(jnp.broadcast_shapes(
@@ -312,16 +434,24 @@ class Gumbel(Distribution):
         ))
 
     def sample(self, shape=()):
-        shp = _shape_of(shape, self.loc, self.scale)
-        return _wrap(self.loc + self.scale * jax.random.gumbel(
-            split_key(), shp
-        ))
+        g = jax.random.gumbel(
+            split_key(), _shape_of(shape, self._loc, self._scale)
+        )
+        return _traced(
+            "gumbel_rsample", lambda l, s: l + s * g,
+            self._loc, self._scale,
+        )
 
     rsample = sample
 
     def log_prob(self, value):
-        z = (_arr(value) - self.loc) / self.scale
-        return _wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+        return _traced(
+            "gumbel_log_prob",
+            lambda l, s, v: (
+                -((v - l) / s + jnp.exp(-(v - l) / s)) - jnp.log(s)
+            ),
+            self._loc, self._scale, value,
+        )
 
     @property
     def mean(self):
@@ -332,11 +462,15 @@ class Gumbel(Distribution):
         return _wrap(jnp.square(self.scale) * (math.pi ** 2) / 6)
 
     def entropy(self):
-        return _wrap(jnp.log(self.scale) + 1 + np.euler_gamma)
+        return _traced(
+            "gumbel_entropy",
+            lambda s: jnp.log(s) + 1 + np.euler_gamma, self._scale,
+        )
 
 
 class Gamma(Distribution):
     def __init__(self, concentration, rate, name=None):
+        self._conc, self._rate = concentration, rate
         self.concentration = _arr(concentration)
         self.rate = _arr(rate)
         super().__init__(jnp.broadcast_shapes(
@@ -344,18 +478,20 @@ class Gamma(Distribution):
         ))
 
     def sample(self, shape=()):
-        shp = _shape_of(shape, self.concentration, self.rate)
-        g = jax.random.gamma(split_key(), jnp.broadcast_to(
-            self.concentration, shp
-        ))
-        return _wrap(g / self.rate)
+        shp = _shape_of(shape, self._conc, self._rate)
+        g = jax.random.gamma(
+            split_key(), jnp.broadcast_to(self.concentration, shp)
+        )
+        return _traced("gamma_sample_scale", lambda r: g / r, self._rate)
 
     def log_prob(self, value):
-        v = _arr(value)
-        a, b = self.concentration, self.rate
-        return _wrap(
-            a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
-            - jax.scipy.special.gammaln(a)
+        return _traced(
+            "gamma_log_prob",
+            lambda a, b, v: (
+                a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                - jax.scipy.special.gammaln(a)
+            ),
+            self._conc, self._rate, value,
         )
 
     @property
@@ -367,15 +503,19 @@ class Gamma(Distribution):
         return _wrap(self.concentration / jnp.square(self.rate))
 
     def entropy(self):
-        a, b = self.concentration, self.rate
-        return _wrap(
-            a - jnp.log(b) + jax.scipy.special.gammaln(a)
-            + (1 - a) * jax.scipy.special.digamma(a)
+        return _traced(
+            "gamma_entropy",
+            lambda a, b: (
+                a - jnp.log(b) + jax.scipy.special.gammaln(a)
+                + (1 - a) * jax.scipy.special.digamma(a)
+            ),
+            self._conc, self._rate,
         )
 
 
 class Beta(Distribution):
     def __init__(self, alpha, beta, name=None):
+        self._alpha, self._beta = alpha, beta
         self.alpha = _arr(alpha)
         self.beta = _arr(beta)
         super().__init__(jnp.broadcast_shapes(
@@ -383,7 +523,7 @@ class Beta(Distribution):
         ))
 
     def sample(self, shape=()):
-        shp = _shape_of(shape, self.alpha, self.beta)
+        shp = _shape_of(shape, self._alpha, self._beta)
         return _wrap(jax.random.beta(
             split_key(),
             jnp.broadcast_to(self.alpha, shp),
@@ -391,13 +531,16 @@ class Beta(Distribution):
         ))
 
     def log_prob(self, value):
-        v = _arr(value)
-        a, b = self.alpha, self.beta
-        lbeta = (
-            jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
-            - jax.scipy.special.gammaln(a + b)
+        return _traced(
+            "beta_log_prob",
+            lambda a, b, v: (
+                (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                - (jax.scipy.special.gammaln(a)
+                   + jax.scipy.special.gammaln(b)
+                   - jax.scipy.special.gammaln(a + b))
+            ),
+            self._alpha, self._beta, value,
         )
-        return _wrap((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta)
 
     @property
     def mean(self):
@@ -411,6 +554,7 @@ class Beta(Distribution):
 
 class Dirichlet(Distribution):
     def __init__(self, concentration, name=None):
+        self._conc = concentration
         self.concentration = _arr(concentration)
         super().__init__(
             jnp.shape(self.concentration)[:-1],
@@ -424,12 +568,15 @@ class Dirichlet(Distribution):
         ))
 
     def log_prob(self, value):
-        v = _arr(value)
-        a = self.concentration
-        lnorm = jnp.sum(jax.scipy.special.gammaln(a), -1) - (
-            jax.scipy.special.gammaln(jnp.sum(a, -1))
+        return _traced(
+            "dirichlet_log_prob",
+            lambda a, v: (
+                jnp.sum((a - 1) * jnp.log(v), -1)
+                - (jnp.sum(jax.scipy.special.gammaln(a), -1)
+                   - jax.scipy.special.gammaln(jnp.sum(a, -1)))
+            ),
+            self._conc, value,
         )
-        return _wrap(jnp.sum((a - 1) * jnp.log(v), -1) - lnorm)
 
     @property
     def mean(self):
@@ -442,6 +589,7 @@ class Dirichlet(Distribution):
 class Multinomial(Distribution):
     def __init__(self, total_count, probs, name=None):
         self.total_count = int(total_count)
+        self._probs = probs
         self.probs_arr = _arr(probs)
         super().__init__(
             jnp.shape(self.probs_arr)[:-1], jnp.shape(self.probs_arr)[-1:]
@@ -459,13 +607,16 @@ class Multinomial(Distribution):
         return _wrap(jnp.sum(onehot, axis=axis))
 
     def log_prob(self, value):
-        v = _arr(value)
-        logp = jnp.log(jnp.clip(self.probs_arr, 1e-12, None))
-        coeff = (
-            jax.scipy.special.gammaln(jnp.asarray(self.total_count + 1.0))
-            - jnp.sum(jax.scipy.special.gammaln(v + 1.0), -1)
+        n = float(self.total_count)
+        return _traced(
+            "multinomial_log_prob",
+            lambda p, v: (
+                jax.scipy.special.gammaln(jnp.asarray(n + 1.0))
+                - jnp.sum(jax.scipy.special.gammaln(v + 1.0), -1)
+                + jnp.sum(v * jnp.log(jnp.clip(p, 1e-12, None)), -1)
+            ),
+            self._probs, value,
         )
-        return _wrap(coeff + jnp.sum(v * logp, -1))
 
     @property
     def mean(self):
@@ -495,34 +646,61 @@ def kl_divergence(p, q):
 
 @register_kl(Normal, Normal)
 def _kl_normal(p, q):
-    var_ratio = jnp.square(p.scale / q.scale)
-    t1 = jnp.square((p.loc - q.loc) / q.scale)
-    return _wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    return _traced(
+        "kl_normal_normal",
+        lambda pl, ps, ql, qs: 0.5 * (
+            jnp.square(ps / qs) + jnp.square((pl - ql) / qs)
+            - 1 - jnp.log(jnp.square(ps / qs))
+        ),
+        p._loc, p._scale, q._loc, q._scale,
+    )
 
 
 @register_kl(Uniform, Uniform)
 def _kl_uniform(p, q):
-    return _wrap(jnp.log((q.high - q.low) / (p.high - p.low)))
+    return _traced(
+        "kl_uniform_uniform",
+        lambda pl, ph, ql, qh: jnp.log((qh - ql) / (ph - pl)),
+        p._low, p._high, q._low, q._high,
+    )
 
 
 @register_kl(Bernoulli, Bernoulli)
 def _kl_bernoulli(p, q):
-    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
-    qq = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
-    return _wrap(
-        pp * (jnp.log(pp) - jnp.log(qq))
-        + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq))
-    )
+    def fn(pp, qp):
+        pc = jnp.clip(pp, 1e-7, 1 - 1e-7)
+        qc = jnp.clip(qp, 1e-7, 1 - 1e-7)
+        return (
+            pc * (jnp.log(pc) - jnp.log(qc))
+            + (1 - pc) * (jnp.log1p(-pc) - jnp.log1p(-qc))
+        )
+
+    return _traced("kl_bernoulli", fn, p._probs, q._probs)
 
 
 @register_kl(Categorical, Categorical)
 def _kl_categorical(p, q):
-    logp = jax.nn.log_softmax(p.logits, -1)
-    logq = jax.nn.log_softmax(q.logits, -1)
-    return _wrap(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+    def fn(pl, ql):
+        logp = jax.nn.log_softmax(pl, -1)
+        logq = jax.nn.log_softmax(ql, -1)
+        return jnp.sum(jnp.exp(logp) * (logp - logq), -1)
+
+    return _traced(
+        "kl_categorical",
+        fn,
+        p._logits if p._logits is not None else jnp.log(
+            jnp.clip(_arr(p._probs_in), 1e-12, None)
+        ),
+        q._logits if q._logits is not None else jnp.log(
+            jnp.clip(_arr(q._probs_in), 1e-12, None)
+        ),
+    )
 
 
 @register_kl(Exponential, Exponential)
 def _kl_exponential(p, q):
-    r = q.rate / p.rate
-    return _wrap(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
+    return _traced(
+        "kl_exponential",
+        lambda pr, qr: jnp.log(pr) - jnp.log(qr) + qr / pr - 1,
+        p._rate, q._rate,
+    )

@@ -15,6 +15,7 @@ Two entry points:
     so updates happen in-place in device memory.
 """
 from .api import StaticFunction, TrainStep, ignore_module, not_to_static, to_static
+from .serialization import InputSpec, TranslatedLayer, load, save
 
 __all__ = [
     "to_static",
@@ -22,4 +23,8 @@ __all__ = [
     "ignore_module",
     "StaticFunction",
     "TrainStep",
+    "save",
+    "load",
+    "InputSpec",
+    "TranslatedLayer",
 ]

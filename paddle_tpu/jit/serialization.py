@@ -1,0 +1,177 @@
+"""jit.save / jit.load — AOT model export over StableHLO.
+
+ref: python/paddle/jit/api.py jit.save -> TranslatedLayer
+(jit/translated_layer.py) and the inference deployment path
+(fluid/inference AnalysisPredictor). TPU-native: the deployable artifact
+is a serialized StableHLO program (jax.export) + the parameter arrays —
+the same compiled-serving shape as §2.14 #28 (AOT XLA executables); no
+TensorRT analogue is needed because XLA is the server compiler too.
+
+Artifact layout at <path>:
+    <path>.pdmodel   serialized StableHLO (jax.export blob)
+    <path>.pdiparams parameters + buffers (framework save format)
+    <path>.pdmeta    input spec metadata (json)
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import export as jax_export
+
+from ..core import autograd
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["save", "load", "InputSpec", "TranslatedLayer"]
+
+
+class InputSpec:
+    """ref: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = convert_dtype(dtype).name
+        self.name = name
+
+    def _sds(self):
+        shape = [1 if (d is None or d < 0) else d for d in self.shape]
+        return jax.ShapeDtypeStruct(
+            tuple(shape), convert_dtype(self.dtype).jnp_dtype
+        )
+
+    def to_json(self):
+        return {"shape": self.shape, "dtype": self.dtype, "name": self.name}
+
+    @classmethod
+    def from_json(cls, d):
+        return cls(d["shape"], d["dtype"], d.get("name"))
+
+
+def save(layer, path, input_spec=None, **config):
+    """Stage layer.forward on the given specs and export (ref jit/api.py
+    jit.save). Dynamic dims in specs are exported at size 1 (XLA static
+    shapes; re-export per bucket for other sizes)."""
+    if isinstance(layer, Layer):
+        fn = layer.forward
+        params = [p for _, p in layer.named_parameters()]
+        buffers = [b for _, b in layer.named_buffers()]
+        state = layer.state_dict()
+    else:
+        fn = layer
+        params, buffers, state = [], [], {}
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec")
+    specs = [
+        s if isinstance(s, InputSpec) else InputSpec(**s)
+        for s in input_spec
+    ]
+
+    p_arrays = [p._data for p in params]
+    b_arrays = [b._data for b in buffers]
+
+    def staged(param_arrays, buffer_arrays, *inputs):
+        from .api import _swap_payloads
+
+        old_p = _swap_payloads(params, param_arrays)
+        old_b = _swap_payloads(buffers, buffer_arrays)
+        try:
+            with autograd.no_grad():
+                out = fn(*[Tensor(i) for i in inputs])
+        finally:
+            _swap_payloads(params, old_p)
+            _swap_payloads(buffers, old_b)
+        return jax.tree_util.tree_map(
+            lambda o: o._data if isinstance(o, Tensor) else o,
+            out,
+            is_leaf=lambda o: isinstance(o, Tensor),
+        )
+
+    exported = jax_export.export(jax.jit(staged))(
+        [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in p_arrays],
+        [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in b_arrays],
+        *[s._sds() for s in specs],
+    )
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    from ..framework.io_api import save as fsave
+
+    fsave({"params": state}, path + ".pdiparams")
+    with open(path + ".pdmeta", "w") as f:
+        json.dump(
+            {
+                "input_spec": [s.to_json() for s in specs],
+                "param_names": [
+                    name for name, _ in (
+                        layer.named_parameters()
+                        if isinstance(layer, Layer) else []
+                    )
+                ],
+                "buffer_names": [
+                    name for name, _ in (
+                        layer.named_buffers()
+                        if isinstance(layer, Layer) else []
+                    )
+                ],
+            },
+            f,
+        )
+
+
+class TranslatedLayer:
+    """Loaded inference artifact (ref jit/translated_layer.py). Runs the
+    deserialized StableHLO program; parameters are baked as call inputs."""
+
+    def __init__(self, exported, param_arrays, buffer_arrays, meta):
+        self._exported = exported
+        self._params = param_arrays
+        self._buffers = buffer_arrays
+        self._meta = meta
+
+    def __call__(self, *inputs):
+        arrs = [
+            i._data if isinstance(i, Tensor) else jnp.asarray(i)
+            for i in inputs
+        ]
+        out = self._exported.call(self._params, self._buffers, *arrs)
+        return jax.tree_util.tree_map(
+            lambda a: Tensor(a, stop_gradient=True), out
+        )
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    @property
+    def input_spec(self):
+        return [
+            InputSpec.from_json(d) for d in self._meta["input_spec"]
+        ]
+
+
+def load(path, **config):
+    """ref jit/api.py paddle.jit.load."""
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    from ..framework.io_api import load as fload
+
+    blob = fload(path + ".pdiparams")
+    with open(path + ".pdmeta") as f:
+        meta = json.load(f)
+    state = blob["params"]
+    p_arrays = [
+        state[n]._data if isinstance(state[n], Tensor)
+        else jnp.asarray(np.asarray(state[n]))
+        for n in meta["param_names"]
+    ]
+    b_arrays = [
+        state[n]._data if isinstance(state[n], Tensor)
+        else jnp.asarray(np.asarray(state[n]))
+        for n in meta["buffer_names"]
+    ]
+    return TranslatedLayer(exported, p_arrays, b_arrays, meta)

@@ -1,5 +1,9 @@
 """Flagship model definitions (Llama-family decoder for the BASELINE
 configs; vision models live in paddle_tpu.vision.models)."""
+from .dit import DiT, DiTConfig, dit_b_4, dit_xl_2
 from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel
 
-__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM"]
+__all__ = [
+    "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+    "DiT", "DiTConfig", "dit_xl_2", "dit_b_4",
+]

@@ -1,0 +1,190 @@
+"""Diffusion Transformer (DiT) — BASELINE config #5's model family.
+
+Capability target: the DiT/SD3-class architecture (patchify -> adaLN-Zero
+transformer blocks conditioned on timestep+class -> unpatchify to noise
+prediction). TPU-first: attention routes through
+scaled_dot_product_attention (Pallas-eligible), all conditioning is
+elementwise-fused by XLA, shapes are static.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import ops as F
+from ..nn.layer.common import Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+
+
+class DiTConfig:
+    def __init__(self, input_size=32, patch_size=2, in_channels=4,
+                 hidden_size=1152, depth=28, num_heads=16, mlp_ratio=4.0,
+                 num_classes=1000, learn_sigma=False):
+        self.input_size = input_size
+        self.patch_size = patch_size
+        self.in_channels = in_channels
+        self.hidden_size = hidden_size
+        self.depth = depth
+        self.num_heads = num_heads
+        self.mlp_ratio = mlp_ratio
+        self.num_classes = num_classes
+        self.learn_sigma = learn_sigma
+
+    @classmethod
+    def tiny(cls, **over):
+        base = dict(input_size=8, patch_size=2, in_channels=4,
+                    hidden_size=64, depth=2, num_heads=4, num_classes=10)
+        base.update(over)
+        return cls(**base)
+
+
+def _timestep_embedding(t, dim, max_period=10000.0):
+    """Sinusoidal timestep features (DDPM convention)."""
+    from ..core.tensor import Tensor
+
+    half = dim // 2
+    freqs = Tensor(
+        np.exp(
+            -math.log(max_period)
+            * np.arange(half, dtype=np.float32) / half
+        )
+    )
+    args = F.unsqueeze(F.cast(t, "float32"), -1) * freqs
+    return F.concat([F.cos(args), F.sin(args)], axis=-1)
+
+
+class TimestepEmbedder(Layer):
+    def __init__(self, hidden_size, freq_dim=256):
+        super().__init__()
+        self.freq_dim = freq_dim
+        self.fc1 = Linear(freq_dim, hidden_size)
+        self.fc2 = Linear(hidden_size, hidden_size)
+
+    def forward(self, t):
+        h = _timestep_embedding(t, self.freq_dim)
+        return self.fc2(F.silu(self.fc1(h)))
+
+
+class DiTBlock(Layer):
+    """adaLN-Zero block: LN -> modulate(shift,scale) -> attn/mlp -> gated
+    residual, with the modulation parameters produced from the
+    conditioning vector."""
+
+    def __init__(self, hidden_size, num_heads, mlp_ratio=4.0):
+        super().__init__()
+        self.num_heads = num_heads
+        self.norm1 = LayerNorm(hidden_size, weight_attr=False,
+                               bias_attr=False)
+        self.qkv = Linear(hidden_size, hidden_size * 3)
+        self.proj = Linear(hidden_size, hidden_size)
+        self.norm2 = LayerNorm(hidden_size, weight_attr=False,
+                               bias_attr=False)
+        mlp_dim = int(hidden_size * mlp_ratio)
+        self.mlp_fc1 = Linear(hidden_size, mlp_dim)
+        self.mlp_fc2 = Linear(mlp_dim, hidden_size)
+        # adaLN-zero: 6 modulation vectors, zero-init so blocks start as
+        # identity
+        from ..nn import initializer as I
+        from ..nn.parameter import ParamAttr
+
+        self.ada = Linear(
+            hidden_size, 6 * hidden_size,
+            weight_attr=ParamAttr(initializer=I.Constant(0.0)),
+            bias_attr=ParamAttr(initializer=I.Constant(0.0)),
+        )
+
+    def _attn(self, x):
+        b, s, d = x.shape
+        h = self.num_heads
+        qkv = F.reshape(self.qkv(x), [b, s, 3, h, d // h])
+        q = F.squeeze(F.slice(qkv, [2], [0], [1]), 2)
+        k = F.squeeze(F.slice(qkv, [2], [1], [2]), 2)
+        v = F.squeeze(F.slice(qkv, [2], [2], [3]), 2)
+        out = F.scaled_dot_product_attention(q, k, v)
+        return self.proj(F.reshape(out, [b, s, d]))
+
+    def forward(self, x, c):
+        mods = self.ada(F.silu(c))  # [b, 6*d]
+        (shift_a, scale_a, gate_a, shift_m, scale_m, gate_m) = F.split(
+            mods, 6, axis=-1
+        )
+
+        def mod(h, shift, scale):
+            return h * (1.0 + F.unsqueeze(scale, 1)) + F.unsqueeze(shift, 1)
+
+        x = x + F.unsqueeze(gate_a, 1) * self._attn(
+            mod(self.norm1(x), shift_a, scale_a)
+        )
+        h = mod(self.norm2(x), shift_m, scale_m)
+        x = x + F.unsqueeze(gate_m, 1) * self.mlp_fc2(
+            F.gelu(self.mlp_fc1(h), True)
+        )
+        return x
+
+
+class DiT(Layer):
+    """Full DiT: forward(x [b,c,h,w], t [b], y [b]) -> noise pred."""
+
+    def __init__(self, config: DiTConfig):
+        super().__init__()
+        self.config = config
+        cfg = config
+        self.num_patches = (cfg.input_size // cfg.patch_size) ** 2
+        patch_dim = cfg.in_channels * cfg.patch_size ** 2
+        self.x_embed = Linear(patch_dim, cfg.hidden_size)
+        from ..core.tensor import Tensor
+        from ..nn.parameter import Parameter
+
+        self.pos_embed = Parameter(
+            (np.random.RandomState(0).randn(
+                1, self.num_patches, cfg.hidden_size
+            ) * 0.02).astype(np.float32)
+        )
+        self.t_embed = TimestepEmbedder(cfg.hidden_size)
+        self.y_embed = Embedding(cfg.num_classes + 1, cfg.hidden_size)
+        self.blocks = LayerList(
+            [DiTBlock(cfg.hidden_size, cfg.num_heads, cfg.mlp_ratio)
+             for _ in range(cfg.depth)]
+        )
+        self.final_norm = LayerNorm(cfg.hidden_size, weight_attr=False,
+                                    bias_attr=False)
+        out_c = cfg.in_channels * (2 if cfg.learn_sigma else 1)
+        self.final = Linear(cfg.hidden_size, cfg.patch_size ** 2 * out_c)
+
+    def _patchify(self, x):
+        b, c, h, w = x.shape
+        p = self.config.patch_size
+        x = F.reshape(x, [b, c, h // p, p, w // p, p])
+        x = F.transpose(x, [0, 2, 4, 3, 5, 1])  # b, gh, gw, p, p, c
+        return F.reshape(x, [b, (h // p) * (w // p), p * p * c])
+
+    def _unpatchify(self, x, out_c):
+        b = x.shape[0]
+        p = self.config.patch_size
+        g = self.config.input_size // p
+        x = F.reshape(x, [b, g, g, p, p, out_c])
+        x = F.transpose(x, [0, 5, 1, 3, 2, 4])
+        return F.reshape(x, [b, out_c, g * p, g * p])
+
+    def forward(self, x, t, y):
+        cfg = self.config
+        h = self.x_embed(self._patchify(x)) + self.pos_embed
+        c = self.t_embed(t) + self.y_embed(y)
+        for blk in self.blocks:
+            h = blk(h, c)
+        h = self.final(self.final_norm(h))
+        out_c = cfg.in_channels * (2 if cfg.learn_sigma else 1)
+        return self._unpatchify(h, out_c)
+
+
+def dit_xl_2(**over):
+    return DiT(DiTConfig(patch_size=2, hidden_size=1152, depth=28,
+                         num_heads=16, **over))
+
+
+def dit_b_4(**over):
+    return DiT(DiTConfig(patch_size=4, hidden_size=768, depth=12,
+                         num_heads=12, **over))

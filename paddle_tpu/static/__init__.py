@@ -1,0 +1,61 @@
+"""paddle.static — the deployment-facing subset.
+
+ref: python/paddle/static (Program/Executor graph mode, static/io
+save/load_inference_model). Design decision (SURVEY §7 step 3): the
+define-and-run Program/Executor frontend is subsumed by program capture —
+`paddle.jit.to_static` stages define-by-run code into one XLA program,
+which is what Program construction + PirInterpreter execution achieve in
+the reference. This namespace keeps the *artifact* APIs reference users
+script against (InputSpec, save/load_inference_model, normalize_program)
+over the StableHLO export path; the graph-construction API
+(program_guard et al.) intentionally has no equivalent and raises with
+guidance.
+"""
+from __future__ import annotations
+
+from ..jit.serialization import InputSpec, TranslatedLayer
+from ..jit.serialization import load as _jit_load
+from ..jit.serialization import save as _jit_save
+
+__all__ = [
+    "InputSpec", "save_inference_model", "load_inference_model",
+    "normalize_program", "Program", "program_guard", "default_main_program",
+]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """ref: static/io.py save_inference_model. `fetch_vars` carries the
+    layer (program) to export; `feed_vars` the InputSpecs."""
+    layer = kwargs.get("program") or fetch_vars
+    specs = [
+        v if isinstance(v, InputSpec) else InputSpec(v.shape, v.dtype.name)
+        for v in (feed_vars if isinstance(feed_vars, (list, tuple))
+                  else [feed_vars])
+    ]
+    _jit_save(layer, path_prefix, input_spec=specs)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """ref: static/io.py load_inference_model -> (program, feed_names,
+    fetch_names) triple; here the program IS the callable artifact."""
+    tl = _jit_load(path_prefix)
+    feed_names = [s.name or f"x{i}" for i, s in enumerate(tl.input_spec)]
+    return tl, feed_names, None
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    return program
+
+
+def _no_graph_mode(*a, **k):
+    raise NotImplementedError(
+        "the define-and-run Program/Executor frontend has no TPU-native "
+        "equivalent; stage define-by-run code with paddle.jit.to_static "
+        "(training: paddle.jit.TrainStep, deployment: paddle.jit.save)"
+    )
+
+
+Program = _no_graph_mode
+program_guard = _no_graph_mode
+default_main_program = _no_graph_mode

@@ -266,3 +266,68 @@ class TestReviewRegressions:
         )
         dist.checkpoint.load_state_dict({"w": target}, str(tmp_path / "c6"))
         assert target.dtype.name == "float32"
+
+
+class TestReviewRegressions2:
+    def test_distribution_gradients_flow(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+
+        mu = paddle.to_tensor(np.asarray([0.5], np.float32))
+        mu.stop_gradient = False
+        sigma = paddle.to_tensor(np.asarray([1.0], np.float32))
+        sigma.stop_gradient = False
+        d = Normal(mu, sigma)
+        lp = d.log_prob(np.asarray([1.0], np.float32))
+        lp.sum().backward()
+        # d/dmu of -(v-mu)^2/(2s^2) = (v-mu)/s^2 = 0.5
+        np.testing.assert_allclose(mu.grad.numpy(), [0.5], rtol=1e-5)
+        mu.grad = None
+        # rsample path (the VAE reparameterization trick)
+        paddle.seed(0)
+        s = d.rsample([3])
+        s.sum().backward()
+        np.testing.assert_allclose(mu.grad.numpy(), [3.0], rtol=1e-5)
+        # kl path
+        mu.grad = None
+        kl = kl_divergence(d, Normal(0.0, 1.0))
+        kl.sum().backward()
+        np.testing.assert_allclose(mu.grad.numpy(), [0.5], rtol=1e-5)
+
+    def test_categorical_scalar_value_batched_logits(self):
+        from paddle_tpu.distribution import Categorical
+
+        d = Categorical(logits=np.zeros((3, 5), np.float32))
+        lp = d.log_prob(np.int32(2))
+        assert lp.shape == [3]
+        np.testing.assert_allclose(
+            lp.numpy(), np.full(3, np.log(0.2)), rtol=1e-5
+        )
+
+    def test_checkpoint_numpy_scalars_roundtrip(self, tmp_path):
+        dist.checkpoint.save_state_dict(
+            {"step": np.int64(7), "lr": np.float32(0.5)},
+            str(tmp_path / "c7"),
+        )
+        sd = {"step": None, "lr": None}
+        dist.checkpoint.load_state_dict(sd, str(tmp_path / "c7"))
+        assert sd["step"] == 7 and isinstance(sd["step"], int)
+        assert abs(sd["lr"] - 0.5) < 1e-7
+
+    def test_checkpoint_unserializable_raises(self, tmp_path):
+        with pytest.raises(TypeError):
+            dist.checkpoint.save_state_dict(
+                {"bad": object()}, str(tmp_path / "c8")
+            )
+
+    def test_launcher_waits_out_pod_on_failure(self, tmp_path):
+        # one worker fails fast; the slow sibling must be reaped before
+        # launch() returns
+        fast = tmp_path / "fast.py"
+        fast.write_text("import sys; sys.exit(2)\n")
+        from paddle_tpu.distributed.launch.main import launch
+
+        code = launch([
+            "--nproc_per_node", "2",
+            "--log_dir", str(tmp_path / "logs"), str(fast),
+        ])
+        assert code == 2

@@ -1,0 +1,158 @@
+"""Native datafeed + jit.save/load + paddle.static tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import native
+
+
+class TestNativeDatafeed:
+    def test_collate_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        images = (rng.rand(64, 8, 8, 3) * 255).astype(np.uint8)
+        idx = rng.permutation(64)[:16]
+        mean = [0.5, 0.4, 0.3]
+        std = [0.2, 0.25, 0.3]
+        got = native.collate_images_u8_nchw(images, idx, mean, std)
+        want = (
+            (images[idx].astype(np.float32) / 255.0
+             - np.asarray(mean, np.float32))
+            / np.asarray(std, np.float32)
+        ).transpose(0, 3, 1, 2)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_gather_rows(self):
+        m = np.random.RandomState(1).rand(100, 12).astype(np.float32)
+        idx = [5, 1, 99, 0]
+        np.testing.assert_array_equal(
+            native.gather_rows_f32(m, idx), m[idx]
+        )
+
+    def test_pack_tokens_padding(self):
+        corpus = np.arange(100, dtype=np.int32)
+        out = native.pack_tokens(corpus, [0, 95], 10, pad_id=-1)
+        np.testing.assert_array_equal(out[0], np.arange(10))
+        np.testing.assert_array_equal(
+            out[1], [95, 96, 97, 98, 99, -1, -1, -1, -1, -1]
+        )
+
+    def test_library_builds(self):
+        # the native path (not just the numpy fallback) must be live in CI
+        assert native.available()
+
+
+class TestJitSaveLoad:
+    def test_roundtrip(self, tmp_path):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(6, 12), nn.ReLU(), nn.Linear(12, 2))
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(3, 6).astype(np.float32)
+        )
+        want = m(x).numpy()
+        path = str(tmp_path / "model")
+        paddle.jit.save(
+            m, path, input_spec=[paddle.jit.InputSpec([3, 6], "float32")]
+        )
+        assert os.path.exists(path + ".pdmodel")
+        loaded = paddle.jit.load(path)
+        np.testing.assert_allclose(loaded(x).numpy(), want, atol=1e-6)
+
+    def test_batchnorm_eval_export(self, tmp_path):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+        m(paddle.to_tensor(np.random.randn(16, 4).astype(np.float32)))
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(4, 4).astype(np.float32)
+        )
+        want = m(x).numpy()
+        path = str(tmp_path / "bn")
+        paddle.jit.save(
+            m, path, input_spec=[paddle.jit.InputSpec([4, 4], "float32")]
+        )
+        loaded = paddle.jit.load(path)
+        np.testing.assert_allclose(loaded(x).numpy(), want, atol=1e-5)
+
+    def test_save_requires_spec(self, tmp_path):
+        with pytest.raises(ValueError):
+            paddle.jit.save(nn.Linear(2, 2), str(tmp_path / "x"))
+
+
+class TestStaticShim:
+    def test_save_load_inference_model(self, tmp_path):
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        m.eval()
+        path = str(tmp_path / "infer")
+        paddle.static.save_inference_model(
+            path, [paddle.static.InputSpec([2, 4], "float32")], m
+        )
+        prog, feeds, _ = paddle.static.load_inference_model(path)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        np.testing.assert_allclose(
+            prog(x).numpy(), m(x).numpy(), atol=1e-6
+        )
+
+    def test_graph_mode_raises_with_guidance(self):
+        with pytest.raises(NotImplementedError):
+            paddle.static.Program()
+
+
+class TestDiT:
+    def test_forward_and_diffusion_step(self):
+        from paddle_tpu.models import DiT, DiTConfig
+
+        paddle.seed(0)
+        m = DiT(DiTConfig.tiny())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 4, 8, 8).astype(np.float32)
+        )
+        t = paddle.to_tensor(np.array([10, 500], np.int32))
+        y = paddle.to_tensor(np.array([3, 7], np.int32))
+        out = m(x, t, y)
+        assert out.shape == [2, 4, 8, 8]
+        noise = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 4, 8, 8).astype(np.float32)
+        )
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        step = paddle.jit.TrainStep(
+            m, lambda mm, x, t, y, n: ((mm(x, t, y) - n) ** 2).mean(),
+            opt, donate=False,
+        )
+        l0 = float(step(x, t, y, noise).numpy())
+        for _ in range(8):
+            lN = float(step(x, t, y, noise).numpy())
+        assert lN < l0
+
+    def test_patchify_roundtrip(self):
+        from paddle_tpu.models.dit import DiT, DiTConfig
+
+        m = DiT(DiTConfig.tiny())
+        x = paddle.to_tensor(
+            np.arange(2 * 4 * 8 * 8, dtype=np.float32).reshape(2, 4, 8, 8)
+        )
+        patches = m._patchify(x)
+        assert patches.shape == [2, 16, 16]  # (8/2)^2 patches, 2*2*4 dims
+        back = m._unpatchify(patches, 4)
+        np.testing.assert_allclose(back.numpy(), x.numpy())
+
+    def test_adaln_zero_identity_at_init(self):
+        """adaLN-zero: gates are zero-init so a fresh block is identity."""
+        from paddle_tpu.models.dit import DiTBlock
+
+        paddle.seed(0)
+        blk = DiTBlock(16, 2)
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(1, 4, 16).astype(np.float32)
+        )
+        c = paddle.to_tensor(
+            np.random.RandomState(3).randn(1, 16).astype(np.float32)
+        )
+        np.testing.assert_allclose(
+            blk(x, c).numpy(), x.numpy(), atol=1e-6
+        )
