@@ -94,9 +94,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:, :1] + jnp.log(l_safe)).reshape(
-            lse_ref.shape[1:]
-        )
+        # lse stored sublane-replicated [8, block_q] (TPU block rule:
+        # trailing block dims divisible by (8, 128))
+        lse = (m_scr[:, :1] + jnp.log(l_safe)).reshape(1, -1)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
@@ -119,11 +120,11 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 8, sq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -154,8 +155,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0].reshape(block_q, 1)
-        delta = delta_ref[0].reshape(block_q, 1)
+        lse = lse_ref[0, 0].reshape(block_q, 1)
+        delta = delta_ref[0, 0].reshape(block_q, 1)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -209,8 +210,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0].reshape(block_q, 1)
-        delta = delta_ref[0].reshape(block_q, 1)
+        lse = lse_ref[0, 0].reshape(block_q, 1)
+        delta = delta_ref[0, 0].reshape(block_q, 1)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -258,9 +259,11 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
     sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    delta = jnp.sum(
+    delta_row = jnp.sum(
         do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )  # [bh, sq]
+    # sublane-replicated like lse (TPU block tiling rule)
+    delta = jnp.broadcast_to(delta_row[:, None, :], (bh, 8, sq))
 
     dq = pl.pallas_call(
         functools.partial(
@@ -273,8 +276,8 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -296,8 +299,8 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),

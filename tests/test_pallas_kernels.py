@@ -97,9 +97,10 @@ class TestFlashAttention:
 
     def test_sdpa_dispatches_to_pallas(self):
         """The op routes causal/no-mask calls through the kernel when the
-        flag is set, and both paths agree."""
+        flag is set (min-seq lowered for the test), and both paths agree."""
         rng = np.random.RandomState(2)
         q = paddle.to_tensor(rng.randn(1, 128, 2, 64).astype(np.float32))
+        paddle.set_flags({"FLAGS_flash_attention_min_seq": 128})
         with_flag = paddle.scaled_dot_product_attention(
             q, q, q, None, 0.0, True
         ).numpy()
@@ -109,7 +110,8 @@ class TestFlashAttention:
                 q, q, q, None, 0.0, True
             ).numpy()
         finally:
-            paddle.set_flags({"FLAGS_use_pallas_kernels": True})
+            paddle.set_flags({"FLAGS_use_pallas_kernels": True,
+                              "FLAGS_flash_attention_min_seq": 2048})
         np.testing.assert_allclose(with_flag, math_out, rtol=2e-4, atol=2e-5)
 
     def test_sdpa_fallback_on_mask(self):
@@ -132,16 +134,20 @@ class TestFlashAttention:
         )
 
     def test_llama_uses_flash_when_eligible(self):
-        """End to end: Llama attention at seq=128 hits the kernel path and
-        still trains."""
+        """End to end: Llama attention at seq=128 hits the kernel path
+        (min-seq lowered) and still trains."""
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
         paddle.seed(0)
-        m = LlamaForCausalLM(LlamaConfig.tiny(hidden_size=128,
-                                              num_attention_heads=2))
-        ids = paddle.to_tensor(
-            np.random.randint(0, 128, (2, 128)).astype(np.int32)
-        )
-        logits, loss = m(ids, labels=ids)
-        loss.backward()
-        assert all(p.grad is not None for p in m.parameters())
+        paddle.set_flags({"FLAGS_flash_attention_min_seq": 128})
+        try:
+            m = LlamaForCausalLM(LlamaConfig.tiny(hidden_size=128,
+                                                  num_attention_heads=2))
+            ids = paddle.to_tensor(
+                np.random.randint(0, 128, (2, 128)).astype(np.int32)
+            )
+            logits, loss = m(ids, labels=ids)
+            loss.backward()
+            assert all(p.grad is not None for p in m.parameters())
+        finally:
+            paddle.set_flags({"FLAGS_flash_attention_min_seq": 2048})
