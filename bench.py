@@ -45,10 +45,11 @@ def main():
     # sized to fit one v5e chip with optimizer state.
     if on_tpu:
         cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_hidden_layers=8, num_attention_heads=16,
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=12, num_attention_heads=16,
             max_position_embeddings=2048,
         )
+        paddle.set_flags({"FLAGS_flash_attention_min_seq": 1024})
         batch, seq, steps, warmup = 8, 1024, 10, 3
     else:  # CPU smoke path so the script always emits its line
         cfg = LlamaConfig.tiny()

@@ -41,6 +41,7 @@ from .parallel import (
     shard_optimizer,
 )
 from .pipeline import PipelineStages, pipeline_apply
+from .recompute import recompute, recompute_sequential
 from .placement import Partial, Placement, Replicate, Shard
 from .sequence_parallel import gather_sequence, ring_attention, split_sequence
 from .process_mesh import ProcessMesh
@@ -56,6 +57,7 @@ __all__ = [
     "reduce_scatter", "scatter", "barrier",
     "ring_attention", "split_sequence", "gather_sequence",
     "pipeline_apply", "PipelineStages",
+    "recompute", "recompute_sequential",
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
     "DataParallel", "shard_layer", "shard_optimizer", "default_mesh",
     "checkpoint",

@@ -38,6 +38,7 @@ class LlamaConfig:
         num_experts=0,
         num_experts_per_tok=2,
         router_aux_loss_coef=0.02,
+        recompute=False,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -54,6 +55,9 @@ class LlamaConfig:
         self.num_experts = num_experts
         self.num_experts_per_tok = num_experts_per_tok
         self.router_aux_loss_coef = router_aux_loss_coef
+        # jax.checkpoint each decoder layer (the reference's recompute
+        # pass, auto_parallel_recompute.py) — bigger batches per chip
+        self.recompute = recompute
 
     @classmethod
     def tiny(cls, **overrides):
@@ -181,7 +185,12 @@ class LlamaModel(Layer):
         hidden = self.embed_tokens(input_ids)
         aux_total = None
         for layer in self.layers:
-            out = layer(hidden, attn_mask)
+            if self.config.recompute:
+                from ..distributed.recompute import recompute as _rc
+
+                out = _rc(layer, hidden, attn_mask)
+            else:
+                out = layer(hidden, attn_mask)
             if isinstance(out, tuple):
                 hidden, aux = out
                 if aux is not None:

@@ -100,12 +100,12 @@ class TestFlashAttention:
         flag is set (min-seq lowered for the test), and both paths agree."""
         rng = np.random.RandomState(2)
         q = paddle.to_tensor(rng.randn(1, 128, 2, 64).astype(np.float32))
-        paddle.set_flags({"FLAGS_flash_attention_min_seq": 128})
-        with_flag = paddle.scaled_dot_product_attention(
-            q, q, q, None, 0.0, True
-        ).numpy()
-        paddle.set_flags({"FLAGS_use_pallas_kernels": False})
         try:
+            paddle.set_flags({"FLAGS_flash_attention_min_seq": 128})
+            with_flag = paddle.scaled_dot_product_attention(
+                q, q, q, None, 0.0, True
+            ).numpy()
+            paddle.set_flags({"FLAGS_use_pallas_kernels": False})
             math_out = paddle.scaled_dot_product_attention(
                 q, q, q, None, 0.0, True
             ).numpy()
