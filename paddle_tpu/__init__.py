@@ -63,7 +63,9 @@ from . import io  # noqa: E402
 from . import jit  # noqa: E402
 from . import nn  # noqa: E402
 from . import optimizer  # noqa: E402
+from . import quantization  # noqa: E402
 from . import regularizer  # noqa: E402
+from . import sparse  # noqa: E402
 from . import static  # noqa: E402
 from . import vision  # noqa: E402
 from .framework.io_api import load, save  # noqa: E402

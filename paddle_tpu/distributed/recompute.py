@@ -22,6 +22,41 @@ from ..nn.layer.layers import Layer
 __all__ = ["recompute", "recompute_sequential"]
 
 
+def _layer_state(l):
+    return [p for _, p in l.named_parameters()] + [
+        b for _, b in l.named_buffers()
+    ]
+
+
+def _callable_state(function):
+    """Params/buffers a non-Layer callable depends on: bound Layer
+    methods and Layers/Tensors captured in closures or default args."""
+    state = []
+    seen = set()
+
+    def visit(v):
+        if isinstance(v, Layer) and id(v) not in seen:
+            seen.add(id(v))
+            state.extend(_layer_state(v))
+        elif isinstance(v, Tensor) and not v.stop_gradient:
+            if id(v) not in seen:
+                seen.add(id(v))
+                state.append(v)
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                visit(item)
+
+    visit(getattr(function, "__self__", None))
+    for cell in getattr(function, "__closure__", None) or ():
+        try:
+            visit(cell.cell_contents)
+        except ValueError:
+            pass
+    for d in getattr(function, "__defaults__", None) or ():
+        visit(d)
+    return state
+
+
 def recompute(function, *args, use_reentrant=True,
               _extra_state=None, **kwargs):
     """Run `function(*args, **kwargs)` with activation checkpointing.
@@ -34,34 +69,12 @@ def recompute(function, *args, use_reentrant=True,
     # flow: Layer instances directly, bound Layer methods, and Layers /
     # Parameters captured in a lambda's closure (the reference pattern
     # recompute(lambda h: self.block(h), h)).
-    def _layer_state(l):
-        return [p for _, p in l.named_parameters()] + [
-            b for _, b in l.named_buffers()
-        ]
-
     if isinstance(function, Layer):
         fn = function.forward
         state = _layer_state(function)
     else:
         fn = function
-        state = []
-        seen = set()
-        owner = getattr(function, "__self__", None)
-        if isinstance(owner, Layer):
-            state.extend(_layer_state(owner))
-            seen.add(id(owner))
-        for cell in getattr(function, "__closure__", None) or ():
-            try:
-                v = cell.cell_contents
-            except ValueError:
-                continue
-            if isinstance(v, Layer) and id(v) not in seen:
-                seen.add(id(v))
-                state.extend(_layer_state(v))
-            elif isinstance(v, Tensor) and not v.stop_gradient:
-                if id(v) not in seen:
-                    seen.add(id(v))
-                    state.append(v)
+        state = _callable_state(function)
         # dedup against explicit args handled below via identity
         arg_ids = {
             id(a) for a in jax.tree_util.tree_leaves(
@@ -152,8 +165,9 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
         seg_state = []
         for f in chunk:
             if isinstance(f, Layer):
-                seg_state.extend(p for _, p in f.named_parameters())
-                seg_state.extend(b for _, b in f.named_buffers())
+                seg_state.extend(_layer_state(f))
+            else:
+                seg_state.extend(_callable_state(f))
         out = recompute(
             seg_fn, *(out if isinstance(out, tuple) else (out,)),
             _extra_state=seg_state, **kwargs

@@ -1,0 +1,236 @@
+"""Quantization (ref: python/paddle/quantization — QAT/PTQ frameworks
+with observers/quanters; static/quantization passes).
+
+TPU-first scope: simulated quantization (fake-quant with straight-through
+gradients) for QAT, and abs-max observers for PTQ calibration. int8
+matmuls execute on the MXU via XLA's native int8 support when weights are
+converted; the reference's TensorRT deployment path has no analogue.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "FakeQuanterWithAbsMax",
+    "quant_dequant",
+]
+
+
+def quant_dequant(x, scale, bits=8):
+    """Simulated quantization with a straight-through estimator (ref:
+    quantization/quanters fake-quant ops): rounding is treated as
+    identity in backward via x + stop_grad(qdq(x) - x)."""
+    from .. import ops as F
+
+    qmax = float(2 ** (bits - 1) - 1)
+    s = scale if isinstance(scale, Tensor) else Tensor(
+        np.asarray(scale, np.float32)
+    )
+    scaled = x / s * qmax
+    rounded = F.round(scaled)
+    clipped = F.clip(rounded, -qmax, qmax)
+    qdq = clipped / qmax * s
+    return x + (qdq - x).detach()
+
+
+class AbsmaxObserver(Layer):
+    """PTQ calibration observer (ref: quantization/observers/abs_max.py):
+    tracks the running max |x| to derive the scale."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._max = 0.0
+
+    def forward(self, x):
+        from .. import ops as F
+
+        cur = float(F.max(F.abs(x)).numpy())
+        self._max = max(self._max, cur)
+        return x
+
+    def scale(self):
+        return max(self._max, 1e-8)
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """QAT quanter (ref: quantization/quanters/abs_max.py): per-call
+    abs-max scale + STE fake-quant."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        from .. import ops as F
+
+        scale = F.max(F.abs(x.detach()))
+        return quant_dequant(x, scale + 1e-8, self.quant_bits)
+
+
+class QuantConfig:
+    """ref: quantization/config.py QuantConfig — which layer types get
+    which activation/weight quanters."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._type_cfgs = {}  # layer_type -> (activation, weight)
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        for lt in layer_types:
+            self._type_cfgs[lt] = (activation, weight)
+
+    def quantable_types(self):
+        if self._type_cfgs:
+            return tuple(self._type_cfgs)
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
+
+        return (Linear, Conv2D)
+
+    def quanters_for(self, layer):
+        """Fresh (activation, weight) quanter instances for this layer,
+        honoring per-type overrides then the global defaults."""
+        import copy
+
+        act, w = None, None
+        for lt, (a_, w_) in self._type_cfgs.items():
+            if isinstance(layer, lt):
+                act, w = a_, w_
+                break
+        act = act or self.activation
+        w = w or self.weight
+        mk = lambda q: (
+            copy.deepcopy(q) if q is not None else FakeQuanterWithAbsMax()
+        )
+        return mk(act), mk(w)
+
+
+class _QuantWrapper(Layer):
+    """Wraps a layer: fake-quant its input activation and weight."""
+
+    def __init__(self, inner, config: QuantConfig):
+        super().__init__()
+        self.inner = inner
+        self.act_q, self.w_q = config.quanters_for(inner)
+
+    def forward(self, *args, **kwargs):
+        args = tuple(
+            self.act_q(a) if isinstance(a, Tensor) else a for a in args
+        )
+        w = self.inner.weight
+        orig = w._data
+        qdq_w = self.w_q(w)
+        w._data = qdq_w._data
+        # carry the STE grad path: route through the quantized weight's
+        # tape node by temporarily swapping payload+node
+        node, oi, sg = w._grad_node, w._out_index, w.stop_gradient
+        w._grad_node = qdq_w._grad_node
+        w._out_index = qdq_w._out_index
+        w.stop_gradient = qdq_w.stop_gradient
+        try:
+            out = self.inner(*args, **kwargs)
+        finally:
+            w._data = orig
+            w._grad_node, w._out_index, w.stop_gradient = node, oi, sg
+        return out
+
+
+class QAT:
+    """ref: quantization/qat.py QAT.quantize — wrap quantable layers with
+    fake quanters for quantization-aware training."""
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        types = self.config.quantable_types()
+
+        def convert(layer):
+            for name, sub in list(layer.named_children()):
+                if isinstance(sub, types):
+                    setattr(layer, name, _QuantWrapper(sub, self.config))
+                else:
+                    convert(sub)
+
+        convert(model)
+        return model
+
+
+class PTQ:
+    """ref: quantization/ptq.py PTQ — insert observers, calibrate with
+    data, then `convert` bakes the scales into fake-quant wrappers."""
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig()
+        self._observers = []
+
+    def quantize(self, model: Layer, inplace=False):
+        if not inplace:
+            import copy
+
+            model = copy.deepcopy(model)
+        types = self.config.quantable_types()
+        observers = self._observers
+
+        class _Observed(Layer):
+            def __init__(self, inner):
+                super().__init__()
+                self.inner = inner
+                self.obs = AbsmaxObserver()
+                observers.append(self.obs)
+
+            def forward(self, *a, **k):
+                a = tuple(
+                    self.obs(x) if isinstance(x, Tensor) else x for x in a
+                )
+                return self.inner(*a, **k)
+
+        def convert(layer):
+            for name, sub in list(layer.named_children()):
+                if isinstance(sub, types):
+                    setattr(layer, name, _Observed(sub))
+                else:
+                    convert(sub)
+
+        convert(model)
+        return model
+
+    def convert(self, model: Layer, inplace=False):
+        """Replace observers with fixed-scale fake quant on activations."""
+        def swap(layer):
+            for name, sub in list(layer.named_children()):
+                if type(sub).__name__ == "_Observed":
+                    scale = sub.obs.scale()
+                    inner = sub.inner
+
+                    class _Fixed(Layer):
+                        def __init__(self, inner, scale):
+                            super().__init__()
+                            self.inner = inner
+                            self._scale = scale
+
+                        def forward(self, *a, **k):
+                            a = tuple(
+                                quant_dequant(x, self._scale)
+                                if isinstance(x, Tensor) else x
+                                for x in a
+                            )
+                            return self.inner(*a, **k)
+
+                    setattr(layer, name, _Fixed(inner, scale))
+                else:
+                    swap(sub)
+
+        swap(model)
+        return model

@@ -1,0 +1,143 @@
+"""paddle.sparse analogue (ref: python/paddle/sparse/__init__.py —
+COO/CSR creation, conversion, elementwise + matmul ops over
+phi/kernels/sparse).
+
+TPU-first: backed by jax.experimental.sparse.BCOO — XLA lowers sparse
+contractions to gather/scatter+dot programs (TPUs have no sparse MXU
+mode; the reference's cuSPARSE kernels have no analogue, so BCOO's
+compiled lowering is the honest equivalent).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+    "is_sparse", "matmul", "add", "relu",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (ref: phi/core/sparse_coo_tensor.h)."""
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        from ..core.dtype import convert_dtype
+
+        return convert_dtype(self._bcoo.dtype)
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return self
+
+    def numpy(self):
+        return np.asarray(self._bcoo.todense())
+
+    def __repr__(self):
+        return (
+            f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+            f"dtype={self.dtype.name})"
+        )
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """Build from [ndim, nnz] indices + [nnz] values (ref
+    python/paddle/sparse/creation.py sparse_coo_tensor)."""
+    idx = np.asarray(
+        indices.numpy() if isinstance(indices, Tensor) else indices
+    )
+    val = jnp.asarray(
+        values._data if isinstance(values, Tensor) else values
+    )
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        val = val.astype(convert_dtype(dtype).jnp_dtype)
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=1))
+    bcoo = jsparse.BCOO(
+        (val, jnp.asarray(idx.T, jnp.int32)), shape=tuple(shape)
+    )
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    """CSR accepted, stored as COO internally (BCOO is the XLA-lowered
+    format; ref sparse/creation.py sparse_csr_tensor)."""
+    crows = np.asarray(
+        crows.numpy() if isinstance(crows, Tensor) else crows
+    )
+    cols = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    return sparse_coo_tensor(
+        np.stack([rows, cols]), values, shape, dtype
+    )
+
+
+def is_sparse(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def matmul(x, y):
+    """sparse @ dense (ref sparse/binary.py matmul). Differentiable
+    w.r.t. the DENSE operand (recorded on the tape); gradients w.r.t.
+    sparse values are not supported in v1."""
+    from ..core import dispatch
+
+    if isinstance(x, SparseCooTensor):
+        yt = y if isinstance(y, Tensor) else Tensor(jnp.asarray(y))
+        bcoo = x._bcoo
+        return dispatch.call("sparse_matmul", lambda d: bcoo @ d, (yt,), {})
+    if isinstance(y, SparseCooTensor):
+        xt = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+        bcoo = y._bcoo
+        return dispatch.call(
+            "sparse_matmul", lambda d: (bcoo.T @ d.T).T, (xt,), {}
+        )
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    ya = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor(xa @ ya)
+
+
+def add(x, y):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        return SparseCooTensor(
+            jsparse.bcoo_sum_duplicates(x._bcoo + y._bcoo)
+        )
+    raise TypeError("sparse.add expects two SparseCooTensors")
+
+
+def relu(x):
+    """ref sparse/unary.py relu — elementwise on the stored values."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.relu expects a SparseCooTensor")
+    b = x._bcoo
+    return SparseCooTensor(
+        jsparse.BCOO((jnp.maximum(b.data, 0), b.indices), shape=b.shape)
+    )

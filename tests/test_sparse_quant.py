@@ -1,0 +1,147 @@
+"""sparse + quantization tests (ref: test/legacy_test/test_sparse_*.py,
+test/quantization patterns)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import quantization as Q
+from paddle_tpu import sparse as S
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        idx = np.array([[0, 1, 2], [1, 0, 2]])
+        vals = np.array([1.0, 2.0, 3.0], np.float32)
+        t = S.sparse_coo_tensor(idx, vals, shape=[3, 3])
+        assert t.nnz == 3 and t.shape == [3, 3]
+        dense = t.to_dense().numpy()
+        want = np.zeros((3, 3), np.float32)
+        want[0, 1], want[1, 0], want[2, 2] = 1, 2, 3
+        np.testing.assert_allclose(dense, want)
+
+    def test_csr_construction(self):
+        # 2x3 matrix [[1,0,2],[0,3,0]]
+        t = S.sparse_csr_tensor(
+            [0, 2, 3], [0, 2, 1], np.array([1.0, 2.0, 3.0], np.float32),
+            shape=[2, 3],
+        )
+        np.testing.assert_allclose(
+            t.to_dense().numpy(), [[1, 0, 2], [0, 3, 0]]
+        )
+
+    def test_spmm(self):
+        idx = np.array([[0, 1], [1, 0]])
+        sp = S.sparse_coo_tensor(idx, np.array([2.0, 4.0], np.float32),
+                                 shape=[2, 2])
+        d = paddle.to_tensor(np.eye(2, dtype=np.float32))
+        out = S.matmul(sp, d)
+        np.testing.assert_allclose(out.numpy(), [[0, 2], [4, 0]])
+
+    def test_sparse_add_relu(self):
+        idx = np.array([[0, 1], [0, 1]])
+        a = S.sparse_coo_tensor(idx, np.array([1.0, -2.0], np.float32),
+                                shape=[2, 2])
+        b = S.sparse_coo_tensor(idx, np.array([3.0, -1.0], np.float32),
+                                shape=[2, 2])
+        c = S.add(a, b)
+        np.testing.assert_allclose(
+            c.to_dense().numpy(), [[4, 0], [0, -3]]
+        )
+        r = S.relu(c)
+        np.testing.assert_allclose(
+            r.to_dense().numpy(), [[4, 0], [0, 0]]
+        )
+
+
+class TestQuantization:
+    def test_quant_dequant_roundtrip_and_ste(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 9).astype(np.float32))
+        x.stop_gradient = False
+        qdq = Q.quant_dequant(x, 1.0, bits=8)
+        assert np.abs(qdq.numpy() - x.numpy()).max() < 1 / 127 + 1e-6
+        qdq.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(9), rtol=1e-6)
+
+    def test_qat_wraps_and_trains(self):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        m = Q.QAT().quantize(m)
+        names = [type(l).__name__ for _, l in m.named_children()]
+        assert "_QuantWrapper" in names
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=m.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(16, 8).astype(np.float32)
+        )
+        y = paddle.to_tensor(
+            np.random.RandomState(1).randn(16, 1).astype(np.float32)
+        )
+        losses = []
+        for _ in range(30):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_ptq_calibrate_convert(self):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 4))
+        ptq = Q.PTQ()
+        m = ptq.quantize(m)
+        for _ in range(3):
+            m(paddle.to_tensor(
+                np.random.RandomState(5).randn(8, 4).astype(np.float32) * 3
+            ))
+        m = ptq.convert(m)
+        out = m(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        assert out.shape == [2, 4]
+
+
+class TestReviewRegressions:
+    def test_recompute_sequential_lambda_grads(self):
+        from paddle_tpu.distributed import recompute_sequential
+
+        paddle.seed(0)
+        blk = nn.Linear(8, 8)
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        x.stop_gradient = False
+        out = recompute_sequential({"segments": 1}, [lambda h: blk(h)], x)
+        out.sum().backward()
+        assert blk.weight.grad is not None
+
+    def test_sparse_matmul_dense_grad(self):
+        idx = np.array([[0, 1], [1, 0]])
+        sp = S.sparse_coo_tensor(idx, np.array([2.0, 4.0], np.float32),
+                                 shape=[2, 2])
+        d = paddle.to_tensor(np.eye(2, dtype=np.float32))
+        d.stop_gradient = False
+        out = S.matmul(sp, d)
+        out.sum().backward()
+        # d(sum(A@D))/dD = A^T @ ones(2,2), A = [[0,2],[4,0]]
+        np.testing.assert_allclose(
+            d.grad.numpy(), np.array([[4, 4], [2, 2]], np.float32)
+        )
+
+    def test_quantize_not_inplace(self):
+        m = nn.Sequential(nn.Linear(4, 4))
+        m2 = Q.QAT().quantize(m, inplace=False)
+        assert m2 is not m
+        assert type(m[0]).__name__ == "Linear"
+        assert type(m2[0]).__name__ == "_QuantWrapper"
+
+    def test_custom_quanter_honored(self):
+        calls = []
+
+        class MyQ(nn.Layer):
+            def forward(self, x):
+                calls.append(1)
+                return x
+
+        cfg = Q.QuantConfig(activation=MyQ(), weight=MyQ())
+        m = Q.QAT(cfg).quantize(nn.Sequential(nn.Linear(4, 4)),
+                                inplace=True)
+        m(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        assert calls  # custom quanter invoked
