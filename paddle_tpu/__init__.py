@@ -54,6 +54,7 @@ from . import framework  # noqa: E402
 from . import device  # noqa: E402
 from . import distributed  # noqa: E402
 from . import distribution  # noqa: E402
+from . import geometric  # noqa: E402
 from . import hapi  # noqa: E402
 from . import incubate  # noqa: E402
 from .hapi import Model  # noqa: E402

@@ -1,0 +1,127 @@
+"""paddle.geometric analogue (ref: python/paddle/geometric — message
+passing send_u_recv/send_ue_recv/segment ops over
+phi/kernels/gpu/send_u_recv_kernel.cu, segment_pool kernels).
+
+TPU-first: gather + jax.ops.segment_{sum,max,min} — XLA lowers segment
+reductions to sorted-scatter programs; static num_segments (dst node
+count) keeps shapes compile-friendly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "segment_sum", "segment_mean",
+    "segment_max", "segment_min",
+]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _segment_reduce(data, seg_ids, num_segments, pool):
+    if pool in ("sum", "add"):
+        return jax.ops.segment_sum(data, seg_ids, num_segments)
+    cnt_shape = (-1,) + (1,) * (data.ndim - 1)
+    cnt = jax.ops.segment_sum(
+        jnp.ones((data.shape[0],), data.dtype), seg_ids, num_segments
+    ).reshape(cnt_shape)
+    if pool == "mean":
+        s = jax.ops.segment_sum(data, seg_ids, num_segments)
+        return s / jnp.maximum(cnt, 1.0)
+    if pool in ("max", "min"):
+        red = (
+            jax.ops.segment_max if pool == "max" else jax.ops.segment_min
+        )(data, seg_ids, num_segments)
+        # reference semantics (phi graph_send_recv/segment_pool kernels):
+        # rows receiving no message are 0, not +-inf
+        return jnp.where(cnt > 0, red, jnp.zeros_like(red))
+    raise ValueError(f"unknown pool_type {pool!r}")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] and reduce onto dst (ref geometric/message_passing/
+    send_recv.py send_u_recv). Differentiable w.r.t. x."""
+    src = np.asarray(
+        src_index.numpy() if isinstance(src_index, Tensor) else src_index
+    ).astype(np.int32)
+    dst = np.asarray(
+        dst_index.numpy() if isinstance(dst_index, Tensor) else dst_index
+    ).astype(np.int32)
+    # reference API: out_size None or <= 0 means "use x's node count"
+    n_out = (
+        int(out_size) if out_size is not None and int(out_size) > 0
+        else _arr(x).shape[0]
+    )
+    xt = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+    def impl(xa):
+        return _segment_reduce(xa[src], dst, n_out, reduce_op)
+
+    return dispatch.call("send_u_recv", impl, (xt,), {})
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Node-feature x[src] combined with edge feature y per edge, reduced
+    onto dst (ref send_recv.py send_ue_recv)."""
+    src = np.asarray(
+        src_index.numpy() if isinstance(src_index, Tensor) else src_index
+    ).astype(np.int32)
+    dst = np.asarray(
+        dst_index.numpy() if isinstance(dst_index, Tensor) else dst_index
+    ).astype(np.int32)
+    n_out = (
+        int(out_size) if out_size is not None and int(out_size) > 0
+        else _arr(x).shape[0]
+    )
+    xt = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    yt = y if isinstance(y, Tensor) else Tensor(jnp.asarray(y))
+
+    def impl(xa, ya):
+        msg = xa[src]
+        if message_op == "add":
+            msg = msg + ya
+        elif message_op == "mul":
+            msg = msg * ya
+        else:
+            raise ValueError(f"unknown message_op {message_op!r}")
+        return _segment_reduce(msg, dst, n_out, reduce_op)
+
+    return dispatch.call("send_ue_recv", impl, (xt, yt), {})
+
+
+def _segment_api(pool):
+    def fn(data, segment_ids, name=None):
+        seg = np.asarray(
+            segment_ids.numpy()
+            if isinstance(segment_ids, Tensor) else segment_ids
+        ).astype(np.int32)
+        n = int(seg.max()) + 1 if seg.size else 0
+        dt = data if isinstance(data, Tensor) else Tensor(jnp.asarray(data))
+
+        def impl(da):
+            return _segment_reduce(da, seg, n, pool)
+
+        return dispatch.call(f"segment_{pool}", impl, (dt,), {})
+
+    fn.__name__ = f"segment_{pool}"
+    fn.__doc__ = (
+        f"ref: python/paddle/geometric/math.py segment_{pool} "
+        "(phi segment_pool kernels). Differentiable w.r.t. data."
+    )
+    return fn
+
+
+segment_sum = _segment_api("sum")
+segment_mean = _segment_api("mean")
+segment_max = _segment_api("max")
+segment_min = _segment_api("min")

@@ -1,0 +1,108 @@
+"""paddle.geometric tests (ref: test/legacy_test/test_graph_send_recv.py,
+test_segment_ops.py patterns)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.geometric as G
+
+
+class TestSendRecv:
+    def test_send_u_recv_sum(self):
+        x = paddle.to_tensor(
+            np.array([[1.0, 2], [3, 4], [5, 6]], np.float32)
+        )
+        src = np.array([0, 1, 2, 0])
+        dst = np.array([1, 2, 1, 0])
+        out = G.send_u_recv(x, src, dst, "sum")
+        want = np.zeros((3, 2), np.float32)
+        for s, d in zip(src, dst):
+            want[d] += x.numpy()[s]
+        np.testing.assert_allclose(out.numpy(), want)
+
+    def test_send_u_recv_mean_max(self):
+        x = paddle.to_tensor(np.array([[2.0], [4.0], [6.0]], np.float32))
+        src = np.array([0, 1, 2])
+        dst = np.array([0, 0, 1])
+        np.testing.assert_allclose(
+            G.send_u_recv(x, src, dst, "mean").numpy(),
+            [[3.0], [6.0], [0.0]],
+        )
+        # empty-destination rows are 0 (reference phi semantics), not -inf
+        np.testing.assert_allclose(
+            G.send_u_recv(x, src, dst, "max").numpy(),
+            [[4.0], [6.0], [0.0]],
+        )
+
+    def test_out_size_negative_ignored(self):
+        x = paddle.to_tensor(np.ones((3, 1), np.float32))
+        out = G.send_u_recv(x, [0, 1], [1, 0], "sum", out_size=-1)
+        assert out.shape == [3, 1]
+
+    def test_isolated_node_min_is_zero(self):
+        x = paddle.to_tensor(np.array([[5.0], [7.0]], np.float32))
+        out = G.send_u_recv(x, [0], [0], "min", out_size=2)
+        np.testing.assert_allclose(out.numpy(), [[5.0], [0.0]])
+
+    def test_send_ue_recv(self):
+        x = paddle.to_tensor(np.array([[1.0], [2.0]], np.float32))
+        e = paddle.to_tensor(np.array([[10.0], [20.0]], np.float32))
+        out = G.send_ue_recv(x, e, [0, 1], [1, 0], "add", "sum")
+        np.testing.assert_allclose(out.numpy(), [[22.0], [11.0]])
+
+    def test_gradient_flows(self):
+        x = paddle.to_tensor(np.ones((3, 2), np.float32))
+        x.stop_gradient = False
+        out = G.send_u_recv(x, [0, 0, 1], [1, 2, 0], "sum")
+        out.sum().backward()
+        # node 0 sent twice, node 1 once, node 2 never
+        np.testing.assert_allclose(
+            x.grad.numpy(), [[2, 2], [1, 1], [0, 0]]
+        )
+
+    def test_gnn_layer_trains(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        lin = nn.Linear(4, 4)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(5, 4).astype(np.float32)
+        )
+        src = np.array([0, 1, 2, 3, 4, 0])
+        dst = np.array([1, 2, 3, 4, 0, 2])
+        y = paddle.to_tensor(
+            np.random.RandomState(1).randn(5, 4).astype(np.float32)
+        )
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=lin.parameters())
+        losses = []
+        for _ in range(20):
+            h = G.send_u_recv(lin(x), src, dst, "mean")
+            loss = ((h - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+class TestSegmentOps:
+    def test_segment_sum_mean(self):
+        data = paddle.to_tensor(
+            np.array([[1.0], [2], [3], [4]], np.float32)
+        )
+        seg = np.array([0, 0, 1, 1])
+        np.testing.assert_allclose(
+            G.segment_sum(data, seg).numpy(), [[3.0], [7.0]]
+        )
+        np.testing.assert_allclose(
+            G.segment_mean(data, seg).numpy(), [[1.5], [3.5]]
+        )
+
+    def test_segment_max_min_grad(self):
+        data = paddle.to_tensor(np.array([1.0, 5, 2, 8], np.float32))
+        data.stop_gradient = False
+        out = G.segment_max(data, np.array([0, 0, 1, 1]))
+        np.testing.assert_allclose(out.numpy(), [5.0, 8.0])
+        out.sum().backward()
+        np.testing.assert_allclose(data.grad.numpy(), [0, 1, 0, 1])
