@@ -50,15 +50,22 @@ class GradScaler:
                 "since the last update()"
             )
         inv = 1.0 / self._scale
-        found = False
+        # One device-side finite flag accumulated across all grads, synced
+        # once at the end — per-param .item() would serialize dispatch with
+        # a host round-trip per parameter.
+        all_finite = None
         for p in optimizer._parameter_list:
             if p.grad is not None:
                 g = p.grad
-                finite = bool(ops.isfinite(g).all().item())
-                if not finite:
-                    found = True
+                g_finite = ops.isfinite(g).all()
+                all_finite = (
+                    g_finite if all_finite is None
+                    else ops.logical_and(all_finite, g_finite)
+                )
                 p.grad = g * inv
-        self._found_inf = found
+        self._found_inf = (
+            all_finite is not None and not bool(all_finite.item())
+        )
         self._unscaled = True
 
     def step(self, optimizer):

@@ -9,8 +9,10 @@
 // normalization, HWC->CHW transpose — into this C++ library, called
 // through ctypes (no pybind available in this image).
 //
-// Build: g++ -O3 -march=native -shared -fPIC datafeed.cc -o libdatafeed.so
-// (driven by paddle_tpu/io/native.py at first use, cached beside this file).
+// Built on first use by io/native.py into a per-user cache dir, keyed on
+// a content hash of this source:
+//   g++ -O3 -shared -fPIC -std=c++17 datafeed.cc -o libdatafeed.so -lpthread
+
 
 #include <atomic>
 #include <cstdint>

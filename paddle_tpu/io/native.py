@@ -2,15 +2,18 @@
 
 ref: §2.14 #30 — the reference's C++ data_feed/data_set/data_loader core.
 The .so is built on first use with the baked-in g++ (pybind11 is not in
-this image; plain C ABI + ctypes instead) and cached next to the source.
-Every entry point has a numpy fallback so the framework works without a
-compiler.
+this image; plain C ABI + ctypes instead) into a per-user cache directory,
+keyed on a content hash of the source — never committed, never stale after
+a clone, and safe across machines (no -march=native). Every entry point
+has a numpy fallback so the framework works without a compiler.
 """
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
+import tempfile
 import threading
 
 import numpy as np
@@ -22,10 +25,19 @@ __all__ = [
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "..", "csrc", "datafeed.cc")
-_SO = os.path.join(_HERE, "..", "csrc", "libdatafeed.so")
 _lock = threading.Lock()
 _lib = None
 _build_failed = False
+
+
+def _cache_dir():
+    base = os.environ.get("PADDLE_TPU_CACHE") or os.path.join(
+        os.environ.get("XDG_CACHE_HOME")
+        or os.path.join(os.path.expanduser("~"), ".cache"),
+        "paddle_tpu",
+    )
+    os.makedirs(base, exist_ok=True)
+    return base
 
 
 def _load():
@@ -36,15 +48,28 @@ def _load():
         if _lib is not None or _build_failed:
             return _lib
         try:
-            if not os.path.exists(_SO) or (
-                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
-            ):
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                     _SRC, "-o", _SO, "-lpthread"],
-                    check=True, capture_output=True,
+            with open(_SRC, "rb") as f:
+                digest = hashlib.sha256(f.read()).hexdigest()[:16]
+            so = os.path.join(_cache_dir(), f"libdatafeed-{digest}.so")
+            if not os.path.exists(so):
+                # build to a temp name then rename: atomic for concurrent
+                # first-use from several processes
+                fd, tmp = tempfile.mkstemp(
+                    suffix=".so", dir=_cache_dir()
                 )
-            lib = ctypes.CDLL(_SO)
+                os.close(fd)
+                try:
+                    subprocess.run(
+                        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                         _SRC, "-o", tmp, "-lpthread"],
+                        check=True, capture_output=True,
+                    )
+                    os.chmod(tmp, 0o644)
+                    os.replace(tmp, so)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+            lib = ctypes.CDLL(so)
             lib.ptpu_collate_images_u8_nchw.argtypes = [
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,

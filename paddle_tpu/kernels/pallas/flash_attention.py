@@ -353,6 +353,14 @@ def flash_attention(q, k, v, *, causal=True, scale=None,
     callers fall back to the math sdpa otherwise (nn_ops dispatch)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    # The kernel has no padding mask for partial tail blocks; out-of-range
+    # rows/cols would silently attend to block padding.
+    if sq % min(int(block_q), sq) or sk % min(int(block_k), sk):
+        raise ValueError(
+            f"flash_attention requires seq lengths divisible by the block "
+            f"sizes: got sq={sq}, sk={sk} with block_q={block_q}, "
+            f"block_k={block_k}; pad the sequence or use the math sdpa"
+        )
     if scale is None:
         scale = 1.0 / (d ** 0.5)
 

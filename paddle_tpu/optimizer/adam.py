@@ -46,11 +46,14 @@ class Adam(Optimizer):
         v = b2 * v + (1 - b2) * jnp.square(g)
         # lr_t = lr * sqrt(1-b2^t) / (1-b1^t): same rescaled form the
         # reference kernel uses (adam_functors.h), fusing both corrections.
-        lr_t = lr * jnp.sqrt(1 - jnp.power(b2, t)) / (1 - jnp.power(b1, t))
-        return m, v, lr_t
+        # In this form epsilon must carry the same sqrt(1-b2^t) factor to
+        # stay equivalent to the textbook vhat form (adam_functors.h:238).
+        corr2 = jnp.sqrt(1 - jnp.power(b2, t))
+        lr_t = lr * corr2 / (1 - jnp.power(b1, t))
+        return m, v, lr_t, eps * corr2
 
     def _update(self, p, g, state, lr, t, attr):
-        m, v, lr_t = self._adam_core(
+        m, v, lr_t, eps_t = self._adam_core(
             p, g, state["moment1"], state["moment2"], lr, t
         )
         new_state = {"moment1": m, "moment2": v}
@@ -59,5 +62,5 @@ class Adam(Optimizer):
             v_max = jnp.maximum(state["moment2_max"], v)
             new_state["moment2_max"] = v_max
             denom_v = v_max
-        new_p = p - lr_t * m / (jnp.sqrt(denom_v) + self._epsilon)
+        new_p = p - lr_t * m / (jnp.sqrt(denom_v) + eps_t)
         return new_p, new_state
