@@ -38,6 +38,12 @@ from .parallel import (
     get_world_size,
     init_parallel_env,
     shard_layer,
+)
+from .sharding import (
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+    group_sharded_parallel,
     shard_optimizer,
 )
 from .pipeline import PipelineStages, pipeline_apply
@@ -60,5 +66,7 @@ __all__ = [
     "recompute", "recompute_sequential",
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
     "DataParallel", "shard_layer", "shard_optimizer", "default_mesh",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3",
+    "group_sharded_parallel",
     "checkpoint",
 ]

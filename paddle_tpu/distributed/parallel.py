@@ -1,8 +1,9 @@
 """Parallel environment + high-level wrappers.
 
 ref: python/paddle/distributed/parallel.py (init_parallel_env:978,
-DataParallel:219), auto_parallel/api.py (shard_layer:844,
-shard_optimizer:1019). TCPStore/NCCL bootstrap collapses to the jax
+DataParallel:219), auto_parallel/api.py (shard_layer:844;
+shard_optimizer lives in distributed/sharding.py). TCPStore/NCCL
+bootstrap collapses to the jax
 coordination service: under multi-host, `jax.distributed.initialize`
 performs the rendezvous the reference does with TCPStore + ncclUniqueId
 exchange (SURVEY §2.6 TPU-equivalent row).
@@ -21,7 +22,7 @@ from .process_mesh import ProcessMesh
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
-    "DataParallel", "shard_layer", "shard_optimizer", "default_mesh",
+    "DataParallel", "shard_layer", "default_mesh",
 ]
 
 _parallel_env = None
@@ -154,18 +155,3 @@ def shard_layer(layer: Layer, process_mesh: ProcessMesh, shard_fn=None,
     for name, sub in layer.named_sublayers(include_self=True):
         shard_fn(name, sub, process_mesh)
     return layer
-
-
-def shard_optimizer(optimizer, shard_fn=None):
-    """ref api.py:1019. Optimizer accumulators are created with
-    zeros_like(param) so they inherit each parameter's NamedSharding
-    automatically; ZeRO-style stages re-placement via shard_fn."""
-    if shard_fn is not None:
-        orig_init = optimizer._init_state
-
-        def wrapped(p_array):
-            st = orig_init(p_array)
-            return shard_fn(st, p_array)
-
-        optimizer._init_state = wrapped
-    return optimizer

@@ -1,0 +1,250 @@
+"""ZeRO-parity sharded optimizer: ShardingStage1/2/3 as GSPMD placements.
+
+ref: python/paddle/distributed/auto_parallel/api.py:1303 (_ShardingStageBase),
+:1343/:1435/:1551 (ShardingStage1/2/3), :1019 (shard_optimizer), and
+python/paddle/distributed/sharding/group_sharded.py (group_sharded_parallel,
+level "os"/"os_g"/"p_g_os") over
+fleet/meta_parallel/sharding/group_sharded_optimizer_stage2.py:53 and
+group_sharded_stage3.py:85.
+
+TPU-native form: the reference implements each stage as explicit rank-local
+slices plus hand-scheduled broadcast/reduce-scatter/all-gather. Here a stage
+is a *layout statement* over the mesh and GSPMD emits those collectives:
+
+- Stage 1 ("os"):   optimizer states (moments + fp32 master weights) carry a
+  Shard placement along the sharding mesh axis. The parameter update then
+  computes on 1/N of the state per device and XLA materialises the
+  reduce-scatter(grad) -> sharded update -> all-gather(param) schedule the
+  reference hand-codes.
+- Stage 2 ("os_g"): additionally, gradients are constrained to the same
+  sharded layout inside the staged train step (reduce-scatter instead of
+  all-reduce; grads never exist replicated).
+- Stage 3 ("p_g_os"): additionally, the parameters themselves are sharded;
+  forward/backward all-gather weights on use (the reference's
+  gather-on-use hooks in group_sharded_stage3.py).
+
+Placement choice matches the reference's get_placement_with_sharding: the
+first tensor dim not already sharded whose size divides the sharding axis
+degree; tensors with no such dim stay replicated (the reference pads —
+padding buys nothing under GSPMD since XLA shards unevenly-divisible dims
+per-op anyway, and tiny scalars aren't worth sharding).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from .dist_tensor import shard_tensor
+from .placement import Replicate, Shard
+from .process_mesh import ProcessMesh
+
+__all__ = [
+    "ShardingStage1", "ShardingStage2", "ShardingStage3",
+    "shard_optimizer", "group_sharded_parallel",
+]
+
+
+def _axis_name(mesh: ProcessMesh, dim) -> str:
+    if isinstance(dim, str):
+        if dim not in mesh.dim_names:
+            raise ValueError(
+                f"sharding_mesh_dim {dim!r} not in mesh axes {mesh.dim_names}"
+            )
+        return dim
+    return mesh.dim_names[int(dim)]
+
+
+def _spec_of(arr) -> list:
+    """Existing PartitionSpec entries of arr (per tensor dim), as a
+    mutable list padded to arr.ndim; [] entries mean unsharded."""
+    spec = [None] * arr.ndim
+    sh = getattr(arr, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        for d, entry in enumerate(sh.spec):
+            if d < arr.ndim:
+                spec[d] = entry
+    return spec
+
+
+def _add_axis_to_spec(arr, mesh: ProcessMesh, axis: str):
+    """Return a NamedSharding = arr's current layout with `axis` added on
+    the first eligible tensor dim, or None when no dim is eligible."""
+    size = mesh.get_dim_size(axis)
+    spec = _spec_of(arr)
+    used = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            used.add(a)
+    if axis in used:
+        return None  # already sharded along this axis
+    for d in range(arr.ndim):
+        if spec[d] is not None:
+            continue  # keep e.g. tp shardings where they are
+        if arr.shape[d] % size != 0 or arr.shape[d] < size:
+            continue
+        spec[d] = axis
+        return NamedSharding(mesh.jax_mesh(), PartitionSpec(*spec))
+    return None
+
+
+class _ShardingStageBase:
+    """Callable shard_fn with the reference signature
+    ``shard_fn(key, param, accumulator) -> accumulator`` (api.py:1389)."""
+
+    stage = 0
+
+    def __init__(self, sharding_mesh_dim, mesh: ProcessMesh | None = None):
+        self._mesh = mesh
+        self._sharding_mesh_dim = sharding_mesh_dim
+
+    def _mesh_axis_for(self, param):
+        meta = getattr(param, "_dist_meta", None)
+        mesh = meta.mesh if meta is not None else self._mesh
+        if mesh is None:
+            from .parallel import default_mesh
+
+            mesh = default_mesh()
+        return mesh, _axis_name(mesh, self._sharding_mesh_dim)
+
+    # -- accumulator placement (all stages) --------------------------------
+    def shard_accumulator(self, key: str, param, acc_array):
+        if acc_array.ndim == 0:
+            return acc_array
+        mesh, axis = self._mesh_axis_for(param)
+        sharding = _add_axis_to_spec(acc_array, mesh, axis)
+        if sharding is None:
+            return acc_array
+        return jax.device_put(acc_array, sharding)
+
+    def __call__(self, key: str, param, accumulator):
+        if isinstance(accumulator, Tensor):
+            out = Tensor(
+                self.shard_accumulator(key, param, accumulator._data),
+                stop_gradient=True,
+            )
+            return out
+        return self.shard_accumulator(key, param, accumulator)
+
+    # -- gradient layout (stage >= 2) --------------------------------------
+    def grad_sharding(self, param):
+        if self.stage < 2 or param._data.ndim == 0:
+            return None
+        mesh, axis = self._mesh_axis_for(param)
+        return _add_axis_to_spec(param._data, mesh, axis)
+
+    # -- parameter layout (stage 3) ----------------------------------------
+    def shard_parameter(self, param):
+        if self.stage < 3:
+            return
+        meta = getattr(param, "_dist_meta", None)
+        mesh, axis = self._mesh_axis_for(param)
+        axis_idx = mesh.dim_names.index(axis)
+        placements = (
+            list(meta.placements) if meta is not None
+            else [Replicate()] * mesh.ndim
+        )
+        if not placements[axis_idx].is_replicate():
+            return  # already laid out along the sharding axis
+        sharded_dims = {
+            p.get_dim() for p in placements if p.is_shard()
+        }
+        size = mesh.shape[axis_idx]
+        for d in range(param._data.ndim):
+            if d in sharded_dims:
+                continue
+            if param._data.shape[d] % size != 0 or param._data.shape[d] < size:
+                continue
+            placements[axis_idx] = Shard(d)
+            break
+        else:
+            return
+        d = shard_tensor(
+            param, mesh, placements, stop_gradient=param.stop_gradient
+        )
+        param._rebind(d._data, dist_meta=d._dist_meta)
+
+
+class ShardingStage1(_ShardingStageBase):
+    """Optimizer-state sharding (ZeRO-1; ref api.py:1343)."""
+
+    stage = 1
+
+
+class ShardingStage2(_ShardingStageBase):
+    """+ gradient sharding (ZeRO-2; ref api.py:1435)."""
+
+    stage = 2
+
+
+class ShardingStage3(_ShardingStageBase):
+    """+ parameter sharding with gather-on-use (ZeRO-3; ref api.py:1551)."""
+
+    stage = 3
+
+
+def shard_optimizer(optimizer, shard_fn=None, gradient_accumulation_steps=1):
+    """Re-place optimizer state (and grads/params per stage) on the mesh
+    (ref api.py:1019). ``shard_fn(key, param, accumulator)`` follows the
+    reference signature; ShardingStage1/2/3 instances are the built-ins.
+
+    Works with both eager ``opt.step()`` and ``jit.TrainStep`` (which picks
+    up ``_grad_sharding_for`` to constrain gradient layout in-program).
+    """
+    if gradient_accumulation_steps != 1:
+        raise NotImplementedError(
+            "gradient_accumulation_steps != 1 is not supported; accumulate "
+            "outside the optimizer (scale the loss by 1/k and step every k "
+            "micro-batches)"
+        )
+    if shard_fn is None:
+        return optimizer
+
+    if isinstance(shard_fn, _ShardingStageBase):
+        if shard_fn.stage >= 3:
+            for p in optimizer._parameter_list:
+                if getattr(p, "trainable", not p.stop_gradient):
+                    shard_fn.shard_parameter(p)
+        if shard_fn.stage >= 2:
+            optimizer._grad_sharding_for = shard_fn.grad_sharding
+
+    params_by_id = {id(p): p for p in optimizer._parameter_list}
+    orig_ensure = optimizer._ensure_state
+    sharded = set()
+
+    def wrapped_ensure(p):
+        st = orig_ensure(p)
+        if id(p) not in sharded:
+            sharded.add(id(p))
+            param = params_by_id.get(id(p), p)
+            for key in list(st):
+                out = shard_fn(key, param, st[key])
+                st[key] = out._data if isinstance(out, Tensor) else out
+        return st
+
+    optimizer._ensure_state = wrapped_ensure
+    return optimizer
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None,
+                           group=None, mesh=None, sharding_mesh_dim=0,
+                           offload=False, sync_buffers=False, **kwargs):
+    """One-call ZeRO wrapper (ref distributed/sharding/group_sharded.py:33
+    group_sharded_parallel; level "os" / "os_g" / "p_g_os")."""
+    stages = {"os": ShardingStage1, "os_g": ShardingStage2,
+              "p_g_os": ShardingStage3}
+    if level not in stages:
+        raise ValueError(
+            f"level must be one of {sorted(stages)}, got {level!r}"
+        )
+    if offload:
+        raise NotImplementedError(
+            "offload is not supported; on TPU use sharded states over the "
+            "mesh (this API) or remat (paddle.distributed.recompute)"
+        )
+    optimizer = shard_optimizer(
+        optimizer, stages[level](sharding_mesh_dim, mesh)
+    )
+    return model, optimizer, scaler

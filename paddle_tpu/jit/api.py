@@ -302,10 +302,24 @@ class TrainStep:
                     self._live_idx = live_idx
                 live = [params[i] for i in live_idx]
                 attrs = tuple(self._attr_for(p) for p in live)
+                live_grads = [p.grad._data for p in live]
+                # ZeRO stage>=2: constrain gradient layout in-program so XLA
+                # reduce-scatters instead of all-reducing. Shardings were
+                # precomputed from concrete payloads in __call__ (params are
+                # tracers here).
+                if self._grad_shardings is not None:
+                    live_grads = [
+                        jax.lax.with_sharding_constraint(g, s)
+                        if (s := self._grad_shardings[i]) is not None else g
+                        for i, g in zip(live_idx, live_grads)
+                    ]
+                targets = tuple(
+                    self._out_shardings[i] for i in live_idx
+                )
                 new_live, new_states = opt_step_fn(
-                    attrs, lr, t, found_inf,
+                    attrs, targets, lr, t, found_inf,
                     [p._data for p in live],
-                    [p.grad._data for p in live],
+                    live_grads,
                     [states[i] for i in live_idx],
                 )
                 new_param_arrays = list(param_arrays)
@@ -362,6 +376,17 @@ class TrainStep:
         if self._compiled is None:
             self._compiled = self._build()
         states = [opt._ensure_state(p) for p in self._params]
+        # concrete layouts, read before payloads become tracers (static
+        # per-param out constraints for the staged optimizer update)
+        self._out_shardings = tuple(
+            opt._param_out_sharding(p._data, st)
+            for p, st in zip(self._params, states)
+        )
+        grad_sharding = getattr(opt, "_grad_sharding_for", None)
+        self._grad_shardings = (
+            tuple(grad_sharding(p) for p in self._params)
+            if grad_sharding is not None else None
+        )
         lr = jnp.float32(opt.get_lr())
         t = jnp.float32(opt._global_step + 1)
         found_inf = (

@@ -220,13 +220,16 @@ class Optimizer:
     def _make_step_fn(self):
         clip = self._grad_clip
 
-        def step_fn(attrs, lr, t, found_inf, params, grads, states):
+        def step_fn(attrs, out_shardings, lr, t, found_inf, params, grads,
+                    states):
             if clip is not None:
                 grads = clip._clip_arrays(
                     params, grads, [a.need_clip for a in attrs]
                 )
             new_params, new_states = [], []
-            for p, g, s, a in zip(params, grads, states, attrs):
+            for p, g, s, a, (target, state_targets) in zip(
+                params, grads, states, attrs, out_shardings
+            ):
                 compute_p = s["master_weight"] if a.multi_precision else p
                 g = g.astype(compute_p.dtype)
                 if a.reg_kind == "l2":
@@ -242,15 +245,57 @@ class Optimizer:
                     ns["master_weight"] = np_
                     np_ = np_.astype(p.dtype)
                 np_ = jnp.where(found_inf, p, np_)
+                if target is not None:
+                    # ZeRO: sharded-state updates must hand the param back
+                    # in its own layout (GSPMD emits the all-gather here)
+                    np_ = jax.lax.with_sharding_constraint(np_, target)
+                st_map = dict(state_targets)
                 ns = {
-                    k: jnp.where(found_inf, s[k], v) if k in s else v
-                    for k, v in ns.items()
+                    # keep old value under found_inf; each slot keeps its
+                    # declared layout
+                    k: jax.lax.with_sharding_constraint(v, st_map[k])
+                    if st_map.get(k) is not None else v
+                    for k, v in (
+                        (k, jnp.where(found_inf, s[k], v) if k in s else v)
+                        for k, v in ns.items()
+                    )
                 }
                 new_params.append(np_)
                 new_states.append(ns)
             return new_params, new_states
 
-        return jax.jit(step_fn, static_argnums=0)
+        return jax.jit(step_fn, static_argnums=(0, 1))
+
+    @staticmethod
+    def _param_out_sharding(p_arr, state):
+        """Static layout contract for one param's staged update:
+        (param_target, ((state_key, target), ...)). The updated param comes
+        back in the param's own NamedSharding — or replicated over the
+        state's mesh when only the state is sharded (ZeRO stage 1/2: the
+        all-gather) — and each state slot keeps its declared layout."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sh = getattr(p_arr, "sharding", None)
+        mesh = sh.mesh if isinstance(sh, NamedSharding) else None
+        for arr in state.values():
+            ssh = getattr(arr, "sharding", None)
+            if isinstance(ssh, NamedSharding):
+                mesh = ssh.mesh
+                break
+        if mesh is None:
+            return None, ()
+        replicated = NamedSharding(mesh, PartitionSpec())
+        state_targets = tuple(
+            (
+                k,
+                arr.sharding
+                if isinstance(getattr(arr, "sharding", None), NamedSharding)
+                else replicated,
+            )
+            for k, arr in state.items()
+        )
+        param_target = sh if isinstance(sh, NamedSharding) else replicated
+        return param_target, state_targets
 
     @autograd.no_grad()
     def step(self):
@@ -271,10 +316,24 @@ class Optimizer:
             else jnp.asarray(False)
         )
 
+        grad_sharding = getattr(self, "_grad_sharding_for", None)
+        if grad_sharding is not None:
+            # ZeRO stage>=2 eager path: lay each grad out sharded before the
+            # update (device_put = the reduce-scatter's memory effect here;
+            # inside jit.TrainStep the constraint stages the real one)
+            grads = [
+                jax.device_put(g, s)
+                if (s := grad_sharding(p)) is not None else g
+                for p, g in zip(params, grads)
+            ]
+        targets = tuple(
+            self._param_out_sharding(p._data, st)
+            for p, st in zip(params, states)
+        )
         if self._compiled_step is None:
             self._compiled_step = self._make_step_fn()
         new_params, new_states = self._compiled_step(
-            attrs, lr, t, found_inf,
+            attrs, targets, lr, t, found_inf,
             [p._data for p in params], grads, states,
         )
         for p, np_, ns in zip(params, new_params, new_states):
