@@ -1,0 +1,173 @@
+"""Autoregressive generation: static-shape KV-cache decode.
+
+Capability target: the reference's serving/decode subsystem —
+masked_multihead_attention + block_multihead_attention feeding an
+incremental-decode loop (ref: python/paddle/incubate/nn/functional/
+masked_multihead_attention.py, block_multihead_attention.py;
+paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu) and
+the dynamic_decode driver (ref: python/paddle/nn/decode.py:513).
+
+TPU-first design: the cache is a PREALLOCATED fixed buffer
+([b, max_len, kv_heads, d]) with an int32 position scalar; each decode
+step writes via lax.dynamic_update_slice and runs as ONE compiled XLA
+program reused for every token (no shape growth -> no recompilation).
+Sampling (temperature / top-k / top-p) happens inside the staged step so
+the whole token loop is device-resident except the optional EOS check.
+"""
+from __future__ import annotations
+
+import collections
+
+from .. import ops as F
+from ..core.tensor import Tensor
+
+__all__ = ["KVCache", "GenerationConfig", "GenerationMixin"]
+
+# fixed-size decode cache for one attention layer:
+#   k, v: [batch, max_length, num_kv_heads, head_dim]
+KVCache = collections.namedtuple("KVCache", ["k", "v"])
+
+
+class GenerationConfig:
+    """ref: the reference ships generation knobs via op attributes on
+    fused decode kernels (top_p_sampling, masked_multihead_attention);
+    grouped here the way its ecosystem (paddlenlp GenerationConfig)
+    presents them."""
+
+    def __init__(self, max_new_tokens=32, do_sample=False, temperature=1.0,
+                 top_k=0, top_p=1.0, eos_token_id=None, pad_token_id=0):
+        self.max_new_tokens = max_new_tokens
+        self.do_sample = do_sample
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_token_id = eos_token_id
+        self.pad_token_id = pad_token_id
+
+
+def _process_logits(logits, temperature, top_k, top_p):
+    """Logit warps, mirroring the reference's top_p_sampling op semantics
+    (ref: python/paddle/tensor/search.py top_p_sampling). Pure tensor ops
+    so the whole warp stages into the decode program."""
+    if temperature != 1.0:
+        logits = logits / temperature
+    if top_k and top_k > 0:
+        kth = F.topk(logits, top_k, axis=-1)[0][:, -1:]
+        logits = F.where(
+            logits >= kth, logits, F.full_like(logits, -1e30)
+        )
+    if top_p < 1.0:
+        sorted_logits = F.sort(logits, axis=-1, descending=True)
+        probs = F.softmax(sorted_logits, axis=-1)
+        cum = F.cumsum(probs, axis=-1)
+        # keep tokens whose cumulative mass (exclusive) is < top_p; always
+        # keep the argmax
+        keep_sorted = (cum - probs) < top_p
+        # threshold value: smallest logit still kept
+        masked = F.where(
+            keep_sorted, sorted_logits, F.full_like(sorted_logits, 1e30)
+        )
+        thresh = F.min(masked, axis=-1, keepdim=True)
+        logits = F.where(
+            logits >= thresh, logits, F.full_like(logits, -1e30)
+        )
+    return logits
+
+
+def _sample(logits, do_sample, temperature, top_k, top_p):
+    """Next-token selection on [b, vocab] logits. Sampling uses the Gumbel
+    trick (argmax of logits + Gumbel noise == categorical draw) so it
+    rides the framework RNG and stages under jit."""
+    if not do_sample:
+        return F.argmax(logits, axis=-1)
+    logits = _process_logits(logits, temperature, top_k, top_p)
+    u = F.uniform(logits.shape, min=1e-9, max=1.0, dtype="float32")
+    gumbel = -F.log(-F.log(u))
+    return F.argmax(logits.astype("float32") + gumbel, axis=-1)
+
+
+class GenerationMixin:
+    """Adds ``generate`` to a causal-LM Layer.
+
+    Host-side control flow is one python loop over a staged decode step
+    (prefill and decode each compile once; jax.jit caches by shape). The
+    model must implement:
+      * ``init_kv_cache(batch, max_length, dtype)`` -> list of KVCache
+      * ``forward(input_ids, caches=..., position=...)``
+        -> (logits [b, s, vocab], new_caches)
+    """
+
+    def generate(self, input_ids, generation_config=None, **kwargs):
+        """Returns [batch, prompt_len + max_new_tokens] token ids (the
+        prompt is included, finished rows padded with pad_token_id).
+        Explicit kwargs override fields of ``generation_config``; unknown
+        kwargs raise."""
+        if generation_config is not None:
+            cfg = GenerationConfig(**vars(generation_config))
+            for k, v in kwargs.items():
+                if not hasattr(cfg, k):
+                    raise TypeError(f"generate() got unknown kwarg {k!r}")
+                setattr(cfg, k, v)
+        else:
+            cfg = GenerationConfig(**kwargs)
+        b, prompt_len = input_ids.shape
+        max_len = prompt_len + cfg.max_new_tokens
+
+        from ..jit.api import StaticFunction
+
+        if getattr(self, "_decode_fn", None) is None:
+            model = self
+
+            def _step(tok, caches, position, do_sample, temperature,
+                      top_k, top_p):
+                logits, caches = model.forward(
+                    tok, caches=caches, position=position
+                )
+                nxt = _sample(
+                    logits[:, -1], do_sample, temperature, top_k, top_p
+                )
+                return nxt, caches
+
+            self._decode_fn = StaticFunction(_step, layer=self)
+
+        from ..core import autograd
+
+        caches = self.init_kv_cache(b, max_len)
+        position = F.zeros([], "int32")
+        with autograd.no_grad():
+            # prefill: one wide step over the whole prompt
+            nxt, caches = self._decode_fn(
+                input_ids, caches, position,
+                cfg.do_sample, cfg.temperature, cfg.top_k, cfg.top_p,
+            )
+            position = position + prompt_len
+
+            tokens = [input_ids]
+            finished = F.zeros([b], "bool")
+            pad = None
+            if cfg.eos_token_id is not None:
+                pad = F.full([b], cfg.pad_token_id, nxt.dtype)
+            for i in range(cfg.max_new_tokens):
+                if cfg.eos_token_id is not None:
+                    nxt = F.where(finished, pad, nxt)
+                    finished = F.logical_or(
+                        finished, nxt == cfg.eos_token_id
+                    )
+                tokens.append(F.reshape(nxt, [b, 1]))
+                if i == cfg.max_new_tokens - 1:
+                    break
+                if cfg.eos_token_id is not None and bool(
+                    F.all(finished).item()
+                ):
+                    # pad the remainder so the output shape is static
+                    rest = cfg.max_new_tokens - 1 - i
+                    tokens.append(
+                        F.full([b, rest], cfg.pad_token_id, nxt.dtype)
+                    )
+                    break
+                nxt, caches = self._decode_fn(
+                    F.reshape(nxt, [b, 1]), caches, position,
+                    cfg.do_sample, cfg.temperature, cfg.top_k, cfg.top_p,
+                )
+                position = position + 1
+        return F.concat(tokens, axis=1)

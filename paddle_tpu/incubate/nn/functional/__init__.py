@@ -16,6 +16,11 @@ from ....ops import (  # noqa: F401
     scaled_dot_product_attention as fused_dot_product_attention,
 )
 
+from .decode_attention import (  # noqa: F401
+    block_multihead_attention,
+    masked_multihead_attention,
+)
+
 fused_matmul_bias = fused_linear
 
 __all__ = [
@@ -23,4 +28,5 @@ __all__ = [
     "fused_rotary_position_embedding", "rope_qk", "swiglu",
     "fused_linear", "fused_matmul_bias", "fused_bias_act",
     "fused_dot_product_attention",
+    "masked_multihead_attention", "block_multihead_attention",
 ]

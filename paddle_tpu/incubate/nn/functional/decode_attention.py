@@ -1,0 +1,109 @@
+"""Decode-time fused attention functionals.
+
+Reference surface: python/paddle/incubate/nn/functional/
+masked_multihead_attention.py (dense decode cache, one token per step) and
+block_multihead_attention.py (paged block-table cache; CUDA kernel
+phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu). The reference
+signatures carry ~30 CUDA-serving knobs (quant scales, padding offsets,
+cum offsets); the TPU-native forms keep the cache-layout contract and drop
+the CUDA-specific plumbing — quantized caches arrive with the quantization
+subsystem, and padding bookkeeping is unnecessary with static shapes.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor
+
+# pallas kernels import lazily inside the functions (same policy as
+# ops/impl/nn_ops.py's flash dispatch): `import paddle_tpu` must not pay
+# for — or depend on — jax.experimental.pallas.
+
+__all__ = [
+    "masked_multihead_attention", "block_multihead_attention",
+]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def masked_multihead_attention(x, cache_kv, seq_len, *, num_heads,
+                               num_kv_heads=None, scale=None):
+    """One decode step against a dense cache.
+
+    x:        [batch, num_heads * head_dim]  (this step's query, already
+              projected + rotated)
+    cache_kv: (k, v) each [batch, max_len, num_kv_heads, head_dim] with the
+              new token already written at seq_len - 1
+    seq_len:  int32 scalar/[batch] — valid cache length INCLUDING this token
+    Returns [batch, num_heads * head_dim].
+    ref: incubate/nn/functional/masked_multihead_attention.py (the CUDA op
+    fuses the cache write; here slice_scatter stages the write and XLA
+    fuses it with this attention)."""
+    k, v = (_data(cache_kv[0]), _data(cache_kv[1]))
+    xq = _data(x)
+    b = xq.shape[0]
+    num_kv_heads = num_kv_heads or num_heads
+    d = xq.shape[-1] // num_heads
+    q = xq.reshape(b, num_heads, d)
+    group = num_heads // num_kv_heads
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    max_len = k.shape[1]
+    lengths = jnp.broadcast_to(
+        jnp.asarray(_data(seq_len), jnp.int32).reshape(-1), (b,)
+    )
+    qg = q.reshape(b, num_kv_heads, group, d).astype(jnp.float32)
+    kk = jnp.swapaxes(k, 1, 2).astype(jnp.float32)  # [b, kvh, max_len, d]
+    vv = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    s = jnp.einsum("bhgd,bhkd->bhgk", qg, kk) * scale
+    pos = jnp.arange(max_len)
+    s = jnp.where(
+        pos[None, None, None, :] < lengths[:, None, None, None], s, -1e30
+    )
+    import jax
+
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgk,bhkd->bhgd", p, vv)
+    out = out.reshape(b, num_heads * d).astype(xq.dtype)
+    return Tensor(out, stop_gradient=True) if isinstance(x, Tensor) else out
+
+
+def block_multihead_attention(q, k_new, v_new, key_cache, value_cache,
+                              block_tables, seq_lens, *, use_pallas=True,
+                              scale=None):
+    """Paged decode attention: write this step's k/v into their pages, then
+    attend q against the paged cache.
+
+    q/k_new/v_new: [batch, heads(or kv_heads), head_dim]
+    key_cache/value_cache: [num_kv_heads, num_pages, page_size, head_dim]
+    block_tables: [batch, pages_per_seq] int32
+    seq_lens:     [batch] int32 — cache length BEFORE this token
+    Returns (out [batch, num_q_heads, head_dim], key_cache, value_cache,
+    new_seq_lens), mirroring the reference's (out, qkv_out, kcache, vcache)
+    tuple shape. ref: incubate/nn/functional/block_multihead_attention.py."""
+    from ....kernels.pallas.paged_attention import (
+        paged_attention as _paged_kernel,
+        paged_attention_xla as _paged_xla,
+        update_pages as _update_pages,
+    )
+
+    qa, ka, va = _data(q), _data(k_new), _data(v_new)
+    kc, vc = _data(key_cache), _data(value_cache)
+    bt = _data(block_tables).astype(jnp.int32)
+    lens = _data(seq_lens).astype(jnp.int32)
+
+    kc, vc = _update_pages(kc, vc, ka, va, bt, lens)
+    new_lens = lens + 1
+    fn = _paged_kernel if use_pallas else _paged_xla
+    out = fn(qa, kc, vc, bt, new_lens, scale=scale)
+    if isinstance(q, Tensor):
+        return (
+            Tensor(out, stop_gradient=True),
+            Tensor(kc, stop_gradient=True),
+            Tensor(vc, stop_gradient=True),
+            Tensor(new_lens, stop_gradient=True),
+        )
+    return out, kc, vc, new_lens

@@ -76,15 +76,22 @@ def save(layer, path, input_spec=None, **config):
 
     def staged(param_arrays, buffer_arrays, *inputs):
         from .api import _swap_payloads
+        from ..core import random as random_mod
 
         old_p = _swap_payloads(params, param_arrays)
         old_b = _swap_payloads(buffers, buffer_arrays)
+        # rng-marked ops split the global generator key during tracing;
+        # restore it afterwards so no tracer escapes into eager state (the
+        # exported program bakes the keys it drew — inference artifacts are
+        # deterministic by design)
+        old_key = random_mod.default_generator._key
         try:
             with autograd.no_grad():
                 out = fn(*[Tensor(i) for i in inputs])
         finally:
             _swap_payloads(params, old_p)
             _swap_payloads(buffers, old_b)
+            random_mod.default_generator._key = old_key
         return jax.tree_util.tree_map(
             lambda o: o._data if isinstance(o, Tensor) else o,
             out,

@@ -1,0 +1,232 @@
+"""Pallas TPU paged (block-table) KV-cache attention for incremental decode.
+
+The reference serves long-context decode through a paged KV cache: physical
+cache pages indexed per-sequence by a block table
+(ref: paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu,
+python/paddle/incubate/nn/functional/block_multihead_attention.py — the CUDA
+kernel walks `block_tables [bsz, block_num_per_seq]` into
+`key_cache [max_block_num, num_head, block_size, head_size]`).
+
+TPU-native form: one query token per sequence ([batch, heads, head_dim]),
+pages gathered through a scalar-prefetched block table so the page index
+feeds the BlockSpec index_map before the grid step runs (Pallas TPU's
+analogue of the CUDA kernel's pointer chase), online softmax across the
+page sweep. GQA folds query heads into per-kv-head groups so the MXU sees
+a [group, page_size] matmul per page instead of a scalar loop.
+
+Layout:
+  q            [batch, num_q_heads, head_dim]
+  k_pages      [num_kv_heads, num_pages, page_size, head_dim]
+  v_pages      [num_kv_heads, num_pages, page_size, head_dim]
+  block_tables [batch, pages_per_seq] int32  (logical page i of seq b ->
+               physical page block_tables[b, i])
+  lengths      [batch] int32  (tokens currently in the cache per sequence)
+
+On non-TPU backends the kernel runs in interpreter mode so numerics are
+testable on the CPU mesh (same policy as flash_attention.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _decode_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, page_size):
+    b = pl.program_id(0)
+    page = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+    length = lengths_ref[b]
+
+    @pl.when(page == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(page * page_size < length)
+    def _visit():
+        q = q_ref[0, 0].astype(jnp.float32)   # [group_pad, d]
+        k = k_ref[0, 0].astype(jnp.float32)   # [page_size, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [group_pad, page_size]
+
+        # mask cache slots at/after the current length (unwritten tail of
+        # the last partially-filled page)
+        pos = page * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(pos < length, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape,
+        )
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(page == n_pages - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
+                    scale=None):
+    """Decode-mode paged attention. Returns [batch, num_q_heads, head_dim].
+
+    GQA: num_q_heads must be a multiple of num_kv_heads; query heads are
+    grouped per kv head inside the kernel."""
+    batch, n_q_heads, d = q.shape
+    n_kv_heads, n_pages_total, page_size, _ = k_pages.shape
+    pages_per_seq = block_tables.shape[1]
+    if n_q_heads % n_kv_heads:
+        raise ValueError(
+            f"num_q_heads ({n_q_heads}) must be divisible by num_kv_heads "
+            f"({n_kv_heads})"
+        )
+    group = n_q_heads // n_kv_heads
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    # pad the per-kv-head query group up to the fp32 sublane tile (8) so
+    # scratch/block shapes stay tileable; padded rows are sliced off after
+    group_pad = max(8, group)
+    qg = q.reshape(batch, n_kv_heads, group, d)
+    if group_pad != group:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, group_pad - group), (0, 0)))
+
+    grid = (batch, n_kv_heads, pages_per_seq)
+
+    def q_map(b, h, i, lens, tabs):
+        return (b, h, 0, 0)
+
+    def kv_map(b, h, i, lens, tabs):
+        return (h, tabs[b, i], 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_kernel, scale=float(scale), page_size=page_size,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, group_pad, d), q_map),
+                pl.BlockSpec((1, 1, page_size, d), kv_map),
+                pl.BlockSpec((1, 1, page_size, d), kv_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, group_pad, d), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((group_pad, 128), jnp.float32),
+                pltpu.VMEM((group_pad, 128), jnp.float32),
+                pltpu.VMEM((group_pad, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (batch, n_kv_heads, group_pad, d), q.dtype
+        ),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(lengths.astype(jnp.int32), block_tables.astype(jnp.int32),
+      qg, k_pages, v_pages)
+
+    return out[:, :, :group, :].reshape(batch, n_q_heads, d)
+
+
+def paged_attention_xla(q, k_pages, v_pages, block_tables, lengths, *,
+                        scale=None):
+    """Pure-XLA reference of the same contract (gather + masked softmax).
+    Used by tests as the numeric oracle and as the fallback when the
+    Pallas path is disabled."""
+    batch, n_q_heads, d = q.shape
+    n_kv_heads, _, page_size, _ = k_pages.shape
+    pages_per_seq = block_tables.shape[1]
+    group = n_q_heads // n_kv_heads
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    # gather logical caches: [batch, n_kv_heads, pages_per_seq*page_size, d]
+    k = jnp.swapaxes(k_pages[:, block_tables], 0, 1)
+    v = jnp.swapaxes(v_pages[:, block_tables], 0, 1)
+    k = k.reshape(batch, n_kv_heads, pages_per_seq * page_size, d)
+    v = v.reshape(batch, n_kv_heads, pages_per_seq * page_size, d)
+
+    qg = q.reshape(batch, n_kv_heads, group, d).astype(jnp.float32)
+    s = jnp.einsum("bhgd,bhkd->bhgk", qg, k.astype(jnp.float32)) * scale
+    pos = jnp.arange(pages_per_seq * page_size)
+    s = jnp.where(
+        pos[None, None, None, :] < lengths[:, None, None, None], s, NEG_INF
+    )
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgk,bhkd->bhgd", p, v.astype(jnp.float32))
+    return out.reshape(batch, n_q_heads, d).astype(q.dtype)
+
+
+def update_pages(k_pages, v_pages, k_new, v_new, block_tables, lengths):
+    """Write one new token per sequence into its current page slot.
+
+    k_new/v_new: [batch, num_kv_heads, head_dim] — the token at position
+    ``lengths[b]`` of sequence b. Returns updated (k_pages, v_pages).
+    Scatter form (one dynamic_update_slice per batch via vmap-free scatter)
+    so it stages inside a jitted decode step. Sequences already at capacity
+    (lengths[b] == pages_per_seq * page_size) are NOT written — their
+    scatter row is pushed out of bounds so jax drops it — because the
+    gather on block_tables would otherwise clamp to the last page and
+    silently overwrite live cache slots; the caller owns capacity policy
+    (grow the block table or evict), as in the reference's serving loop."""
+    page_size = k_pages.shape[2]
+    capacity = block_tables.shape[1] * page_size
+    logical_page = jnp.minimum(
+        lengths // page_size, block_tables.shape[1] - 1
+    )
+    slot = lengths % page_size
+    phys = jnp.take_along_axis(
+        block_tables, logical_page[:, None], axis=1
+    )[:, 0]  # [batch]
+    # at-capacity rows: point at a nonexistent page so the scatter drops
+    phys = jnp.where(lengths < capacity, phys, k_pages.shape[1])
+
+    # scatter indices: for each (batch, kv_head) write [phys, head, slot]
+    n_kv = k_pages.shape[0]
+    heads = jnp.arange(n_kv)
+    idx = jnp.stack(
+        [
+            jnp.broadcast_to(heads[None, :], (phys.shape[0], n_kv)),
+            jnp.broadcast_to(phys[:, None], (phys.shape[0], n_kv)),
+            jnp.broadcast_to(slot[:, None], (phys.shape[0], n_kv)),
+        ],
+        axis=-1,
+    ).reshape(-1, 3)  # [batch*n_kv, 3]
+    k_upd = k_new.reshape(-1, k_new.shape[-1])  # batch-major over kv heads
+    v_upd = v_new.reshape(-1, v_new.shape[-1])
+    k_pages = k_pages.at[idx[:, 0], idx[:, 1], idx[:, 2]].set(
+        k_upd.astype(k_pages.dtype)
+    )
+    v_pages = v_pages.at[idx[:, 0], idx[:, 1], idx[:, 2]].set(
+        v_upd.astype(v_pages.dtype)
+    )
+    return k_pages, v_pages

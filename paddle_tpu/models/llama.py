@@ -15,6 +15,7 @@ from __future__ import annotations
 import numpy as np
 
 from .. import ops as F
+from ..generation import GenerationMixin, KVCache
 from ..nn.layer.common import Embedding, Linear
 from ..nn.layer.container import LayerList
 from ..nn.layer.layers import Layer
@@ -97,22 +98,61 @@ class LlamaAttention(Layer):
             self.num_heads * self.head_dim, self.hidden_size, bias_attr=bias
         )
 
-    def forward(self, hidden, attn_mask=None):
+    def forward(self, hidden, attn_mask=None, cache=None, position=None):
+        """cache: KVCache([b, max_len, kv_heads, d] k/v) with ``position``
+        (int32 scalar Tensor) = tokens already in the cache. The cached
+        branch keeps static shapes — the cache is a fixed buffer written
+        via slice_scatter (lax.dynamic_update_slice), so every decode step
+        reuses one compiled program (the reference instead grows
+        cache_kvs per step; ref incubate/nn/functional/
+        masked_multihead_attention.py)."""
         b, s = hidden.shape[0], hidden.shape[1]
         q = F.reshape(self.q_proj(hidden), [b, s, self.num_heads, self.head_dim])
         k = F.reshape(self.k_proj(hidden), [b, s, self.num_kv_heads, self.head_dim])
         v = F.reshape(self.v_proj(hidden), [b, s, self.num_kv_heads, self.head_dim])
-        q, k = F.rope_qk(q, k, self.rope_theta)
+        new_cache = None
+        if cache is None:
+            q, k = F.rope_qk(q, k, base=self.rope_theta)
+        else:
+            pos_ids = position + F.arange(s, dtype="int32")
+            q, k = F.rope_qk(q, k, pos_ids, base=self.rope_theta)
+            k = F.slice_scatter(cache.k, k, axes=[1], starts=[position])
+            v = F.slice_scatter(cache.v, v, axes=[1], starts=[position])
+            new_cache = type(cache)(k, v)
         if self.num_kv_heads != self.num_heads:
             # GQA: repeat kv heads (XLA fuses the broadcast into the matmul)
             rep = self.num_heads // self.num_kv_heads
             k = F.repeat_interleave(k, rep, axis=2)
             v = F.repeat_interleave(v, rep, axis=2)
-        # always causal: a user-supplied mask (e.g. padding) composes with
-        # causality rather than replacing it
-        out = F.scaled_dot_product_attention(q, k, v, attn_mask, 0.0, True)
+        if cache is None:
+            # always causal: a user-supplied mask (e.g. padding) composes
+            # with causality rather than replacing it
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask, 0.0, True)
+        else:
+            # causality against the absolute cache timeline: query i sits at
+            # position+i and may see keys j <= position+i (unwritten tail
+            # slots are masked out by the same comparison)
+            max_len = k.shape[1]
+            keep = F.unsqueeze(
+                F.arange(max_len, dtype="int32")
+                <= F.unsqueeze(position + F.arange(s, dtype="int32"), [-1]),
+                [0, 1],
+            )  # [1, 1, s, max_len] bool
+            if attn_mask is not None:
+                # compose with a user mask (e.g. prompt padding) over the
+                # cache timeline, same contract as the non-cached branch
+                if str(attn_mask.dtype) == "paddle_tpu.bool":
+                    keep = F.logical_and(keep, attn_mask)
+                else:
+                    keep = F.where(
+                        keep,
+                        attn_mask.astype("float32"),
+                        F.full_like(attn_mask.astype("float32"), -1e30),
+                    )
+            out = F.scaled_dot_product_attention(q, k, v, keep, 0.0, False)
         out = F.reshape(out, [b, s, self.num_heads * self.head_dim])
-        return self.o_proj(out)
+        out = self.o_proj(out)
+        return out if cache is None else (out, new_cache)
 
 
 class LlamaMLP(Layer):
@@ -155,10 +195,16 @@ class LlamaDecoderLayer(Layer):
         else:
             self.mlp = LlamaMLP(config)
 
-    def forward(self, hidden, attn_mask=None):
+    def forward(self, hidden, attn_mask=None, cache=None, position=None):
         residual = hidden
         hidden = self.input_layernorm(hidden)
-        hidden = self.self_attn(hidden, attn_mask)
+        if cache is None:
+            hidden = self.self_attn(hidden, attn_mask)
+            new_cache = None
+        else:
+            hidden, new_cache = self.self_attn(
+                hidden, attn_mask, cache, position
+            )
         hidden = residual + hidden
         residual = hidden
         hidden = self.post_attention_layernorm(hidden)
@@ -168,6 +214,8 @@ class LlamaDecoderLayer(Layer):
         else:
             hidden = self.mlp(hidden)
         out = residual + hidden
+        if cache is not None:
+            return out, new_cache
         return (out, aux) if self._moe else out
 
 
@@ -181,10 +229,17 @@ class LlamaModel(Layer):
         )
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, caches=None, position=None):
         hidden = self.embed_tokens(input_ids)
         aux_total = None
-        for layer in self.layers:
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if caches is not None:
+                hidden, new_cache = layer(
+                    hidden, attn_mask, caches[i], position
+                )
+                new_caches.append(new_cache)
+                continue
             if self.config.recompute:
                 from ..distributed.recompute import recompute as _rc
 
@@ -198,12 +253,14 @@ class LlamaModel(Layer):
             else:
                 hidden = out
         hidden = self.norm(hidden)
+        if caches is not None:
+            return hidden, new_caches
         if self.config.num_experts > 0:
             return hidden, aux_total
         return hidden
 
 
-class LlamaForCausalLM(Layer):
+class LlamaForCausalLM(GenerationMixin, Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -215,8 +272,36 @@ class LlamaForCausalLM(Layer):
                 config.hidden_size, config.vocab_size, bias_attr=False
             )
 
-    def forward(self, input_ids, labels=None):
-        hidden = self.llama(input_ids)
+    def init_kv_cache(self, batch_size, max_length, dtype=None):
+        """Preallocated static-shape decode cache, one KVCache per layer
+        ([b, max_length, kv_heads, head_dim]) — see GenerationMixin."""
+        c = self.config
+        head_dim = c.hidden_size // c.num_attention_heads
+        dtype = dtype or c.dtype
+        return [
+            KVCache(
+                F.zeros([batch_size, max_length, c.num_key_value_heads,
+                         head_dim], dtype),
+                F.zeros([batch_size, max_length, c.num_key_value_heads,
+                         head_dim], dtype),
+            )
+            for _ in range(c.num_hidden_layers)
+        ]
+
+    def forward(self, input_ids, labels=None, attn_mask=None, caches=None,
+                position=None):
+        if caches is not None:
+            hidden, new_caches = self.llama(
+                input_ids, attn_mask, caches=caches, position=position
+            )
+            if self.lm_head is not None:
+                logits = self.lm_head(hidden)
+            else:
+                logits = F.matmul(
+                    hidden, self.llama.embed_tokens.weight, transpose_y=True
+                )
+            return logits, new_caches
+        hidden = self.llama(input_ids, attn_mask)
         aux = None
         if isinstance(hidden, tuple):
             hidden, aux = hidden

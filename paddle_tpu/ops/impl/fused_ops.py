@@ -84,10 +84,16 @@ def fused_rotary_position_embedding(
     return tuple(outs)
 
 
-def rope_qk(q, k, *, base=10000.0, use_neox_rotary_style=True):
-    """Fast path for the common q,k case (single op on the tape)."""
+def rope_qk(q, k, position_ids=None, *, base=10000.0,
+            use_neox_rotary_style=True):
+    """Fast path for the common q,k case (single op on the tape).
+    position_ids ([b, s] or [s]) offsets the rotation — the decode path
+    rotates the new token at its absolute cache position."""
+    if position_ids is not None and position_ids.ndim == 1:
+        position_ids = position_ids[None, :]
     out = fused_rotary_position_embedding(
-        q, k, None, use_neox_rotary_style=use_neox_rotary_style,
+        q, k, None, position_ids=position_ids,
+        use_neox_rotary_style=use_neox_rotary_style,
         rotary_emb_base=base,
     )
     return out[0], out[1]

@@ -482,3 +482,52 @@ def crop(x, *, shape, offsets):
         builtins_slice(int(o), int(o) + int(s)) for o, s in zip(offsets, shape)
     )
     return x[idx]
+
+
+def slice_scatter(x, value, *, axes=(), starts=(), ends=(), strides=()):
+    """ref: python/paddle/tensor/manipulation.py slice_scatter. Unit-stride
+    writes lower to lax.dynamic_update_slice so a *traced* start (the decode
+    KV-cache position) stages into one compiled program without
+    recompilation; strided writes fall back to indexed .at[].set."""
+    axes = [int(a) for a in axes]
+    starts = [getattr(s, "_data", s) for s in starts]
+    strides = list(strides) if strides else [1] * len(axes)
+    if len(starts) != len(axes) or len(strides) != len(axes) or (
+        len(ends) and len(ends) != len(axes)
+    ):
+        raise ValueError(
+            f"slice_scatter: axes/starts/strides (and ends, if given) must "
+            f"have equal length, got axes={len(axes)} starts={len(starts)} "
+            f"ends={len(ends)} strides={len(strides)}"
+        )
+    unit = all(isinstance(s, int) and s == 1 for s in strides)
+    if unit:
+        # static starts/ends are validated; traced starts follow
+        # lax.dynamic_update_slice semantics (clamped into range — decode
+        # callers must respect their cache capacity)
+        for a, s, e in zip(axes, starts, list(ends) or [None] * len(axes)):
+            if isinstance(s, int) and e is not None:
+                if int(e) - s != value.shape[a]:
+                    raise ValueError(
+                        f"slice_scatter: ends-starts ({int(e) - s}) must "
+                        f"match value.shape[{a}] ({value.shape[a]})"
+                    )
+                if s < 0 or int(e) > x.shape[a]:
+                    raise ValueError(
+                        f"slice_scatter: [{s}, {int(e)}) out of bounds "
+                        f"for axis {a} with size {x.shape[a]}"
+                    )
+        start_idx = [jnp.int32(0)] * x.ndim
+        for a, s in zip(axes, starts):
+            start_idx[a] = jnp.asarray(s, jnp.int32)
+        return jax.lax.dynamic_update_slice(
+            x, value.astype(x.dtype), start_idx
+        )
+    if len(ends) != len(axes):
+        raise ValueError(
+            "slice_scatter: strided writes require ends for every axis"
+        )
+    idx = [builtins_slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = builtins_slice(int(s), int(e), int(st))
+    return x.at[tuple(idx)].set(value.astype(x.dtype))

@@ -1,0 +1,398 @@
+"""Decode path: KV-cache incremental decode, generate(), paged attention.
+
+Mirrors the reference's serving-path tests
+(test/legacy_test/test_masked_multihead_attention_op.py,
+test_block_multihead_attention.py) plus generate-loop semantics.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.ops as F
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+# this CPU backend runs fp32 matmuls in reduced precision by default, so
+# cross-program comparisons carry ~5e-3 noise (same policy as TPU bf16
+# passes); parity asserts use a tolerance sized to that, and argmax-level
+# checks are exact.
+TOL = 3e-2
+
+
+def _model(**over):
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(**over)
+    return LlamaForCausalLM(cfg)
+
+
+def _ids(b, s, vocab=128, seed=0):
+    rng = np.random.default_rng(seed)
+    return paddle.to_tensor(rng.integers(0, vocab, (b, s)).astype("int64"))
+
+
+class TestKVCacheDecode:
+    def test_prefill_matches_full_forward(self):
+        m = _model()
+        ids = _ids(2, 10)
+        full = m(ids).numpy()
+        caches = m.init_kv_cache(2, 16)
+        logits, new_caches = m(
+            ids, caches=caches, position=F.zeros([], "int32")
+        )
+        np.testing.assert_allclose(logits.numpy(), full, atol=TOL)
+        assert new_caches[0].k.shape == [2, 16, 4, 16]
+
+    def test_incremental_matches_full_forward(self):
+        m = _model(num_key_value_heads=2)  # GQA path
+        ids = _ids(2, 8)
+        full = m(ids).numpy()
+        caches = m.init_kv_cache(2, 8)
+        pos = F.zeros([], "int32")
+        outs = []
+        for t in range(8):
+            lg, caches = m(ids[:, t:t + 1], caches=caches, position=pos)
+            outs.append(lg.numpy())
+            pos = pos + 1
+        inc = np.concatenate(outs, axis=1)
+        np.testing.assert_allclose(inc, full, atol=TOL)
+
+    def test_cached_branch_composes_user_mask(self):
+        """A padding mask passed with caches must mask cache keys through
+        the MODEL-level API (review finding: the cached branch used to
+        drop attn_mask, and forward had no way to pass one)."""
+        m = _model()
+        ids = _ids(1, 6)
+        mask = np.ones((1, 1, 6, 6), dtype=bool)
+        # hide cache positions 0-1 from queries 2.. (queries 0-1 keep their
+        # causal self-visibility — a fully-masked row is undefined softmax)
+        mask[:, :, 2:, :2] = False
+        lg_full, _ = m(
+            ids, caches=m.init_kv_cache(1, 6),
+            position=F.zeros([], "int32"),
+        )
+        lg_masked, _ = m(
+            ids, attn_mask=paddle.to_tensor(mask),
+            caches=m.init_kv_cache(1, 6), position=F.zeros([], "int32"),
+        )
+        # masking the earliest keys must change logits for queries >= 2
+        assert (
+            np.abs(
+                lg_full.numpy()[:, 2:] - lg_masked.numpy()[:, 2:]
+            ).max() > 1e-4
+        )
+        # oracle: a model fed only tokens 2.. (causal) reproduces the
+        # masked logits for those queries
+        m2_logits = m(ids[:, 2:]).numpy()
+        np.testing.assert_allclose(
+            lg_masked.numpy()[:, 2:], m2_logits, atol=TOL
+        )
+
+    def test_prefill_then_decode(self):
+        m = _model()
+        ids = _ids(1, 6)
+        caches = m.init_kv_cache(1, 12)
+        lg, caches = m(ids, caches=caches, position=F.zeros([], "int32"))
+        nxt = int(lg.numpy()[0, -1].argmax())
+        lg2, caches = m(
+            paddle.to_tensor(np.array([[nxt]], dtype="int64")),
+            caches=caches,
+            position=F.full([], 6, "int32"),
+        )
+        # oracle: full forward over the extended sequence
+        ext = paddle.to_tensor(
+            np.concatenate([ids.numpy(), [[nxt]]], axis=1)
+        )
+        oracle = m(ext).numpy()[:, -1]
+        np.testing.assert_allclose(lg2.numpy()[:, 0], oracle, atol=TOL)
+
+
+class TestGenerate:
+    def test_greedy_matches_full_recompute(self):
+        m = _model()
+        ids = _ids(2, 10)
+        out = m.generate(ids, max_new_tokens=5)
+        assert out.shape == [2, 15]
+        cur = ids.numpy()
+        for _ in range(5):
+            lg = m(paddle.to_tensor(cur)).numpy()[:, -1]
+            cur = np.concatenate([cur, lg.argmax(-1)[:, None]], axis=1)
+        np.testing.assert_array_equal(out.numpy(), cur)
+
+    def test_sampling_runs_and_is_in_vocab(self):
+        m = _model()
+        ids = _ids(2, 4)
+        out = m.generate(
+            ids, max_new_tokens=6, do_sample=True, temperature=0.8,
+            top_k=20, top_p=0.9,
+        )
+        toks = out.numpy()[:, 4:]
+        assert toks.shape == (2, 6)
+        assert (toks >= 0).all() and (toks < 128).all()
+
+    def test_eos_early_stop_pads(self):
+        m = _model()
+        ids = _ids(1, 4)
+        # force the first generated token to be EOS by picking it as eos id
+        first = m.generate(ids, max_new_tokens=1).numpy()[0, -1]
+        out = m.generate(
+            ids, max_new_tokens=5, eos_token_id=int(first), pad_token_id=7
+        )
+        got = out.numpy()[0, 4:]
+        assert got[0] == first
+        assert (got[1:] == 7).all()
+
+    def test_generation_config_object(self):
+        from paddle_tpu.generation import GenerationConfig
+
+        m = _model()
+        ids = _ids(1, 3)
+        cfg = GenerationConfig(max_new_tokens=2)
+        out = m.generate(ids, generation_config=cfg)
+        assert out.shape == [1, 5]
+        # explicit kwargs override config fields
+        out = m.generate(ids, generation_config=cfg, max_new_tokens=4)
+        assert out.shape == [1, 7]
+        assert cfg.max_new_tokens == 2  # caller's config not mutated
+        with pytest.raises(TypeError):
+            m.generate(ids, generation_config=cfg, beam_width=4)
+
+
+class TestPagedAttention:
+    def _setup(self, B=3, H=8, KV=2, D=64, PS=16, PPS=4, NP=16, seed=0):
+        rng = np.random.default_rng(seed)
+        q = rng.standard_normal((B, H, D)).astype("float32")
+        kp = rng.standard_normal((KV, NP, PS, D)).astype("float32")
+        vp = rng.standard_normal((KV, NP, PS, D)).astype("float32")
+        bt = rng.permutation(NP)[: B * PPS].reshape(B, PPS).astype("int32")
+        lens = np.array([5, 37, 63], dtype="int32")
+        return q, kp, vp, bt, lens
+
+    def test_kernel_matches_oracle(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.kernels.pallas.paged_attention import (
+            paged_attention, paged_attention_xla,
+        )
+
+        q, kp, vp, bt, lens = self._setup()
+        B, H, D = q.shape
+        KV, NP, PS, _ = kp.shape
+        PPS = bt.shape[1]
+        got = np.asarray(
+            paged_attention(
+                jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(bt), jnp.asarray(lens),
+            )
+        )
+        ref = np.asarray(
+            paged_attention_xla(
+                jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(bt), jnp.asarray(lens),
+            )
+        )
+        # float64 oracle
+        G = H // KV
+        oracle = np.zeros((B, H, D))
+        for b in range(B):
+            k = kp[:, bt[b]].reshape(KV, PPS * PS, D).astype("float64")
+            v = vp[:, bt[b]].reshape(KV, PPS * PS, D).astype("float64")
+            for h in range(H):
+                kv = h // G
+                s = (k[kv] @ q[b, h].astype("float64")) / np.sqrt(D)
+                s[lens[b]:] = -np.inf
+                p = np.exp(s - s.max())
+                p /= p.sum()
+                oracle[b, h] = p @ v[kv]
+        np.testing.assert_allclose(got, oracle, atol=TOL)
+        np.testing.assert_allclose(ref, oracle, atol=TOL)
+
+    def test_mha_no_gqa(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.kernels.pallas.paged_attention import (
+            paged_attention, paged_attention_xla,
+        )
+
+        q, kp, vp, bt, lens = self._setup(H=2, KV=2)
+        got = np.asarray(
+            paged_attention(
+                jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(bt), jnp.asarray(lens),
+            )
+        )
+        ref = np.asarray(
+            paged_attention_xla(
+                jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(bt), jnp.asarray(lens),
+            )
+        )
+        np.testing.assert_allclose(got, ref, atol=TOL)
+
+    def test_update_pages(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.kernels.pallas.paged_attention import update_pages
+
+        q, kp, vp, bt, lens = self._setup()
+        B = q.shape[0]
+        KV, _, PS, D = kp.shape
+        rng = np.random.default_rng(1)
+        kn = rng.standard_normal((B, KV, D)).astype("float32")
+        vn = rng.standard_normal((B, KV, D)).astype("float32")
+        kp2, vp2 = update_pages(
+            jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(kn),
+            jnp.asarray(vn), jnp.asarray(bt), jnp.asarray(lens),
+        )
+        for b in range(B):
+            L = int(lens[b])
+            pg = int(bt[b, L // PS])
+            sl = L % PS
+            np.testing.assert_allclose(np.asarray(kp2[:, pg, sl]), kn[b])
+            np.testing.assert_allclose(np.asarray(vp2[:, pg, sl]), vn[b])
+
+    def test_update_pages_at_capacity_is_dropped(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.kernels.pallas.paged_attention import update_pages
+
+        q, kp, vp, bt, lens = self._setup()
+        B = q.shape[0]
+        KV, _, PS, D = kp.shape
+        full = np.full(B, bt.shape[1] * PS, dtype="int32")  # all at capacity
+        rng = np.random.default_rng(4)
+        kn = rng.standard_normal((B, KV, D)).astype("float32")
+        vn = rng.standard_normal((B, KV, D)).astype("float32")
+        kp2, vp2 = update_pages(
+            jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(kn),
+            jnp.asarray(vn), jnp.asarray(bt), jnp.asarray(full),
+        )
+        # cache untouched: no silent overwrite of live slots
+        np.testing.assert_array_equal(np.asarray(kp2), kp)
+        np.testing.assert_array_equal(np.asarray(vp2), vp)
+
+    def test_block_multihead_attention_functional(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        B, H, KV, D, PS, PPS, NP = 2, 4, 2, 32, 8, 2, 8
+        rng = np.random.default_rng(2)
+        q = paddle.to_tensor(rng.standard_normal((B, H, D)).astype("float32"))
+        kn = paddle.to_tensor(rng.standard_normal((B, KV, D)).astype("float32"))
+        vn = paddle.to_tensor(rng.standard_normal((B, KV, D)).astype("float32"))
+        kc = paddle.to_tensor(
+            rng.standard_normal((KV, NP, PS, D)).astype("float32")
+        )
+        vc = paddle.to_tensor(
+            rng.standard_normal((KV, NP, PS, D)).astype("float32")
+        )
+        bt = paddle.to_tensor(
+            rng.permutation(NP)[: B * PPS].reshape(B, PPS).astype("int32")
+        )
+        lens = paddle.to_tensor(np.array([3, 9], dtype="int32"))
+        out, kc2, vc2, newlens = IF.block_multihead_attention(
+            q, kn, vn, kc, vc, bt, lens
+        )
+        assert out.shape == [B, H, D]
+        np.testing.assert_array_equal(newlens.numpy(), [4, 10])
+        # against the non-pallas path
+        out2, _, _, _ = IF.block_multihead_attention(
+            q, kn, vn, kc, vc, bt, lens, use_pallas=False
+        )
+        np.testing.assert_allclose(out.numpy(), out2.numpy(), atol=TOL)
+
+    def test_masked_multihead_attention_functional(self):
+        import paddle_tpu.incubate.nn.functional as IF
+
+        B, H, D, ML = 2, 4, 16, 8
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((B, H * D)).astype("float32")
+        k = rng.standard_normal((B, ML, H, D)).astype("float32")
+        v = rng.standard_normal((B, ML, H, D)).astype("float32")
+        out = IF.masked_multihead_attention(
+            paddle.to_tensor(x),
+            (paddle.to_tensor(k), paddle.to_tensor(v)),
+            paddle.to_tensor(np.array(5, dtype="int32")),
+            num_heads=H,
+        )
+        assert out.shape == [B, H * D]
+        # oracle over the 5 valid positions
+        q = x.reshape(B, H, D)
+        s = np.einsum("bhd,bshd->bhs", q, k[:, :5]) / np.sqrt(D)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        oracle = np.einsum("bhs,bshd->bhd", p, v[:, :5]).reshape(B, -1)
+        np.testing.assert_allclose(out.numpy(), oracle, atol=TOL)
+
+
+class TestSliceScatter:
+    def test_static_start(self):
+        x = paddle.zeros([2, 8, 3])
+        v = paddle.ones([2, 2, 3])
+        y = F.slice_scatter(x, v, axes=[1], starts=[3], ends=[5], strides=[1])
+        got = y.numpy()[0, :, 0]
+        np.testing.assert_array_equal(got, [0, 0, 0, 1, 1, 0, 0, 0])
+
+    def test_traced_start(self):
+        x = paddle.zeros([2, 8, 3])
+        v = paddle.ones([2, 2, 3])
+        pos = paddle.to_tensor(np.int32(3))
+        y = F.slice_scatter(x, v, axes=[1], starts=[pos])
+        got = y.numpy()[0, :, 0]
+        np.testing.assert_array_equal(got, [0, 0, 0, 1, 1, 0, 0, 0])
+
+    def test_strided(self):
+        x = paddle.zeros([8])
+        v = paddle.ones([4])
+        y = F.slice_scatter(
+            x, v, axes=[0], starts=[0], ends=[8], strides=[2]
+        )
+        np.testing.assert_array_equal(y.numpy(), [1, 0, 1, 0, 1, 0, 1, 0])
+
+
+class TestDecodeExport:
+    def test_jit_save_load_decode_step(self, tmp_path):
+        """The decode step exports via jit.save and the loaded artifact
+        reproduces the in-process logits (VERDICT r2 #3 done-criterion)."""
+        import paddle_tpu.jit as jit
+        from paddle_tpu.jit.serialization import InputSpec, load
+        from paddle_tpu.models.llama import KVCache
+        from paddle_tpu.nn.layer.layers import Layer
+
+        m = _model()
+        L = m.config.num_hidden_layers
+
+        class DecodeStep(Layer):
+            def __init__(self, model):
+                super().__init__()
+                self.model = model
+
+            def forward(self, tok, ks, vs, position):
+                caches = [
+                    KVCache(ks[i], vs[i]) for i in range(L)
+                ]
+                logits, new_caches = self.model(
+                    tok, caches=caches, position=position
+                )
+                new_ks = F.stack([c.k for c in new_caches])
+                new_vs = F.stack([c.v for c in new_caches])
+                return logits, new_ks, new_vs
+
+        step = DecodeStep(m)
+        path = str(tmp_path / "decode")
+        jit.save(
+            step, path,
+            input_spec=[
+                InputSpec([1, 1], "int64"),
+                InputSpec([L, 1, 8, 4, 16], "float32"),
+                InputSpec([L, 1, 8, 4, 16], "float32"),
+                InputSpec([], "int32"),
+            ],
+        )
+        loaded = load(path)
+        tok = _ids(1, 1)
+        ks = paddle.zeros([L, 1, 8, 4, 16])
+        vs = paddle.zeros([L, 1, 8, 4, 16])
+        pos = F.zeros([], "int32")
+        got = loaded(tok, ks, vs, pos)
+        want = step(tok, ks, vs, pos)
+        np.testing.assert_allclose(
+            got[0].numpy(), want[0].numpy(), atol=TOL
+        )
