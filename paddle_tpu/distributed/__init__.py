@@ -46,7 +46,12 @@ from .sharding import (
     group_sharded_parallel,
     shard_optimizer,
 )
-from .pipeline import PipelineStages, pipeline_apply
+from .pipeline import (
+    PipelineStages,
+    pipeline_1f1b,
+    pipeline_apply,
+    pipeline_program,
+)
 from .recompute import recompute, recompute_sequential
 from .placement import Partial, Placement, Replicate, Shard
 from .sequence_parallel import gather_sequence, ring_attention, split_sequence
@@ -62,7 +67,7 @@ __all__ = [
     "all_reduce", "all_gather", "all_to_all", "broadcast", "reduce",
     "reduce_scatter", "scatter", "barrier",
     "ring_attention", "split_sequence", "gather_sequence",
-    "pipeline_apply", "PipelineStages",
+    "pipeline_apply", "pipeline_program", "pipeline_1f1b", "PipelineStages",
     "recompute", "recompute_sequential",
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
     "DataParallel", "shard_layer", "shard_optimizer", "default_mesh",

@@ -17,9 +17,22 @@ pipelining without a hand-written scheduler; the 1F1B/zero-bubble
 host-side scheduling the reference needs to hide Python/NCCL latency is
 subsumed by XLA's static schedule of the single program.
 
-Supported stage topology: homogeneous stages (same activation shapes in/
-out) — the transformer-block case the reference's "uniform" SegmentLayers
-partition targets. Embedding/head stay outside the pipelined region.
+Two schedules:
+  * pipeline_apply / pipeline_program — GPipe timeline as one lax.scan;
+    backward is jax.grad of the scan (optionally rematerialized).
+  * pipeline_1f1b — interleaved fwd/bwd ticks in ONE scan with an inline
+    hand-rolled backward (recompute-based), capping the activation stash
+    at 2·n_stages micro-batches per stage instead of GPipe's num_micro —
+    the memory property the reference's 1F1B scheduler exists for
+    (fleet/meta_parallel/pipeline_parallel.py:575). Zero-bubble's dW/dX
+    host reordering is subsumed: XLA schedules the fused tick program.
+
+pipeline_program/pipeline_1f1b support heterogeneous EDGES: first_fn
+(e.g. embedding) runs fused into stage 0's timeline, last_fn (head +
+loss) into the last stage's, so the loss is computed inside the
+pipelined region and embedding/head weights train with everything else.
+Interior stages stay homogeneous (same activation shapes), matching the
+reference's "uniform" SegmentLayers partition (pp_layers.py:258).
 """
 from __future__ import annotations
 
@@ -36,7 +49,10 @@ from .dist_tensor import DistMeta, shard_tensor
 from .placement import Replicate, Shard
 from .process_mesh import ProcessMesh
 
-__all__ = ["pipeline_apply", "PipelineStages"]
+__all__ = [
+    "pipeline_apply", "pipeline_program", "pipeline_1f1b",
+    "PipelineStages",
+]
 
 
 def _pipeline_local(params_local, xs, *, stage_fn, axis_name, n_micro):
@@ -105,32 +121,11 @@ def pipeline_apply(stage_fn, stacked_params, x, *, mesh: ProcessMesh,
     Returns the last stage's output, same shape as x, on the tape.
     """
     n_stages = mesh.get_dim_size(axis_name)
-    axis_idx = mesh.dim_names.index(axis_name)
     nm = num_micro_batches or n_stages
     if not isinstance(x, Tensor):
         x = Tensor(x)
-    b = x.shape[0]
-    if b % nm != 0:
-        raise ValueError(
-            f"batch {b} not divisible by num_micro_batches {nm}"
-        )
-
     # lay out stage-stacked params over the pp axis
-    def _prep_param(p):
-        if isinstance(p, Tensor):
-            if p._dist_meta is None:
-                placements = [Replicate()] * mesh.ndim
-                placements[axis_idx] = Shard(0)
-                d = shard_tensor(p, mesh, placements,
-                                 stop_gradient=p.stop_gradient)
-                p._rebind(d._data, dist_meta=d._dist_meta)
-            return p
-        return Tensor(jnp.asarray(p))
-
-    stacked_params = jax.tree_util.tree_map(
-        _prep_param, stacked_params,
-        is_leaf=lambda v: isinstance(v, Tensor),
-    )
+    stacked_params = _prep_stacked(stacked_params, mesh, axis_name)
 
     jmesh = mesh.jax_mesh()
     n_param_spec = jax.tree_util.tree_map(
@@ -159,16 +154,13 @@ def pipeline_apply(stage_fn, stacked_params, x, *, mesh: ProcessMesh,
 
     def impl(x_arr, *param_arrays):
         ptree_params = jax.tree_util.tree_unflatten(ptree, param_arrays)
-        xs = x_arr.reshape((nm, b // nm) + x_arr.shape[1:])
+        xs = _microbatch(x_arr, nm)
         ys = mapped(ptree_params, xs)
         return ys.reshape(x_arr.shape)
 
     from ..core import dispatch
 
-    saved = [(t, t._dist_meta) for t in [x] + flat_params
-             if isinstance(t, Tensor) and t._dist_meta is not None]
-    for t, _ in saved:
-        t._dist_meta = None
+    saved = _dispatch_hidden_meta([x] + flat_params)
     try:
         out = dispatch.call(
             "pipeline_apply", impl, (x,) + tuple(flat_params), {}
@@ -212,3 +204,472 @@ class PipelineStages:
             )
             if isinstance(p, Tensor)
         ]
+
+
+# --------------------------------------------------------------------------
+# Heterogeneous-edge pipelines: first_fn (embedding) fused into stage 0's
+# timeline, last_fn (head + loss) into the last stage's, loss computed
+# INSIDE the pipelined region.  ref: the reference's PipelineLayer places
+# embedding on stage 0 and LMHead+loss on the last stage of one pipeline
+# (fleet/meta_parallel/pp_layers.py SharedLayerDesc; pipeline_parallel.py
+# _broadcast_final_loss); in single-program SPMD form the edge work is
+# masked to its stage (GSPMD's standard treatment of unbalanced work) and
+# edge weights ride replicated across pp (no p2p tied-embedding sync).
+# --------------------------------------------------------------------------
+
+
+def _edge_spec(tree):
+    return jax.tree_util.tree_map(
+        lambda _: PartitionSpec(), tree,
+        is_leaf=lambda v: isinstance(v, Tensor),
+    )
+
+
+def _shape_key(*trees):
+    """Hashable shape/dtype signature for the caller-owned compile cache
+    (the schedule fns' identity is implied by the cache owner)."""
+    leaves = []
+    for t in trees:
+        for leaf in jax.tree_util.tree_leaves(
+            t, is_leaf=lambda v: isinstance(v, Tensor)
+        ):
+            if isinstance(leaf, Tensor):
+                leaves.append((tuple(leaf.shape), str(leaf.dtype)))
+            elif hasattr(leaf, "shape"):
+                leaves.append((tuple(leaf.shape), str(leaf.dtype)))
+    return tuple(leaves)
+
+
+def _pipeline_lm_local(first_arrays, stage_arrays, last_arrays, xs, aux,
+                       *, first_fn, stage_fn, last_fn, axis_name, n_micro,
+                       remat, data_axis=None):
+    """GPipe timeline with fused edges; returns the mean micro-batch loss
+    broadcast to every stage. xs: [n_micro, mb, ...] raw inputs (token
+    ids); aux: [n_micro, mb, ...] loss inputs (labels) or None.
+    data_axis: optional mesh axis carrying a DP batch shard; the loss is
+    pmean'd across it (PP x DP composition)."""
+    n_stages = jax.lax.psum(1, axis_name)  # static under shard_map
+    stage_idx = jax.lax.axis_index(axis_name)
+    params_sq = jax.tree_util.tree_map(lambda p: p[0], stage_arrays)
+    sfn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    hidden = jax.eval_shape(first_fn, first_arrays, xs[0])
+    vaxes = (axis_name,) + ((data_axis,) if data_axis is not None else ())
+    carry0 = jax.lax.pcast(
+        jnp.zeros(hidden.shape, hidden.dtype), vaxes, to="varying"
+    )
+    loss0 = jax.lax.pcast(
+        jnp.zeros((), jnp.float32), vaxes, to="varying"
+    )
+    perm_fwd = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+    def step(state, t):
+        carry, loss_sum = state
+        m_f = jnp.clip(t, 0, n_micro - 1)
+        emb = first_fn(first_arrays, xs[m_f])
+        inp = jnp.where(stage_idx == 0, emb, carry)
+        out = sfn(params_sq, inp)
+        mb = t - (n_stages - 1)
+        mb_c = jnp.clip(mb, 0, n_micro - 1)
+        loss_mb = last_fn(
+            last_arrays, out, aux[mb_c] if aux is not None else None
+        )
+        valid = jnp.logical_and(
+            stage_idx == n_stages - 1,
+            jnp.logical_and(mb >= 0, mb < n_micro),
+        )
+        loss_sum = loss_sum + jnp.where(
+            valid, loss_mb.astype(jnp.float32), 0.0
+        )
+        carry_next = jax.lax.ppermute(out, axis_name, perm_fwd)
+        return (carry_next, loss_sum), None
+
+    (_, loss_sum), _ = jax.lax.scan(
+        step, (carry0, loss0), jnp.arange(n_micro + n_stages - 1)
+    )
+    mask = (stage_idx == n_stages - 1).astype(jnp.float32)
+    loss = jax.lax.psum(loss_sum * mask, axis_name) / n_micro
+    if data_axis is not None:
+        loss = jax.lax.pmean(loss, data_axis)
+    return loss
+
+
+def _prep_stacked(stacked_params, mesh, axis_name):
+    """Shard stage-stacked param Tensors over the pp axis (in place),
+    mirroring pipeline_apply's layout step."""
+    axis_idx = mesh.dim_names.index(axis_name)
+
+    def _prep(p):
+        if isinstance(p, Tensor):
+            if p._dist_meta is None:
+                placements = [Replicate()] * mesh.ndim
+                placements[axis_idx] = Shard(0)
+                d = shard_tensor(p, mesh, placements,
+                                 stop_gradient=p.stop_gradient)
+                p._rebind(d._data, dist_meta=d._dist_meta)
+            return p
+        return Tensor(jnp.asarray(p))
+
+    return jax.tree_util.tree_map(
+        _prep, stacked_params, is_leaf=lambda v: isinstance(v, Tensor)
+    )
+
+
+def _microbatch(arr, nm):
+    b = arr.shape[0]
+    if b % nm != 0:
+        raise ValueError(
+            f"batch {b} not divisible by num_micro_batches {nm}"
+        )
+    return arr.reshape((nm, b // nm) + arr.shape[1:])
+
+
+def _dispatch_hidden_meta(tensors):
+    """Temporarily strip dist metadata so the generic dispatcher (not the
+    dist hook) handles the call — the shard_map inside owns the layout."""
+    saved = [(t, t._dist_meta) for t in tensors
+             if isinstance(t, Tensor) and t._dist_meta is not None]
+    for t, _ in saved:
+        t._dist_meta = None
+    return saved
+
+
+def pipeline_program(first_fn, stage_fn, last_fn, first_params,
+                     stacked_params, last_params, x, aux=None, *,
+                     mesh: ProcessMesh, axis_name="pp",
+                     num_micro_batches=None, remat=False, data_axis=None,
+                     cache=None):
+    """GPipe schedule with embedding/head inside the pipelined region.
+
+    first_fn(first_arrays, x_mb) -> hidden       (stage 0's edge)
+    stage_fn(stage_slice, hidden) -> hidden      (homogeneous interior)
+    last_fn(last_arrays, hidden, aux_mb) -> scalar micro-batch loss
+    Returns the scalar mean loss on the autograd tape; backward is
+    jax.grad of the scanned timeline (remat=True rematerializes each
+    stage application, trading recompute for GPipe's activation memory).
+    data_axis: mesh axis to additionally shard the micro-batch dim over
+    (PP x DP composition; grads average across it via the vjp of pmean).
+    Bubble fraction: (n_stages-1) / (num_micro + n_stages - 1).
+    """
+    n_stages = mesh.get_dim_size(axis_name)
+    nm = num_micro_batches or n_stages
+    if not isinstance(x, Tensor):
+        x = Tensor(x)
+    if aux is not None and not isinstance(aux, Tensor):
+        aux = Tensor(aux)
+    stacked_params = _prep_stacked(stacked_params, mesh, axis_name)
+
+    stacked_spec = jax.tree_util.tree_map(
+        lambda p: PartitionSpec(*([axis_name] + [None] * (p.ndim - 1))),
+        stacked_params, is_leaf=lambda v: isinstance(v, Tensor),
+    )
+    data_spec = PartitionSpec(None, data_axis)  # [nm, mb, ...] mb over dp
+    ckey = ("gpipe", _shape_key(x, aux, first_params, stacked_params,
+                                last_params), nm, remat, data_axis)
+    mapped = None if cache is None else cache.get(ckey)
+    if mapped is None:
+        local = functools.partial(
+            _pipeline_lm_local, first_fn=first_fn, stage_fn=stage_fn,
+            last_fn=last_fn, axis_name=axis_name, n_micro=nm, remat=remat,
+            data_axis=data_axis,
+        )
+        # jit: eager shard_map cannot evaluate closed_call bodies (remat /
+        # nested scan), and one compiled program is the point of the
+        # design; the caller-owned `cache` keeps the jitted callable's
+        # identity stable across steps so XLA compiles once per shape
+        mapped = jax.jit(jax.shard_map(
+            local, mesh=mesh.jax_mesh(),
+            in_specs=(_edge_spec(first_params), stacked_spec,
+                      _edge_spec(last_params), data_spec,
+                      data_spec if aux is not None else None),
+            out_specs=PartitionSpec(),
+        ))
+        if cache is not None:
+            cache[ckey] = mapped
+
+    f_flat, f_tree = jax.tree_util.tree_flatten(
+        first_params, is_leaf=lambda v: isinstance(v, Tensor))
+    s_flat, s_tree = jax.tree_util.tree_flatten(
+        stacked_params, is_leaf=lambda v: isinstance(v, Tensor))
+    l_flat, l_tree = jax.tree_util.tree_flatten(
+        last_params, is_leaf=lambda v: isinstance(v, Tensor))
+    nf, ns = len(f_flat), len(s_flat)
+    aux_arr = aux._data if aux is not None else None
+
+    def impl(x_arr, *param_arrays):
+        fp = jax.tree_util.tree_unflatten(f_tree, param_arrays[:nf])
+        sp = jax.tree_util.tree_unflatten(
+            s_tree, param_arrays[nf:nf + ns])
+        lp = jax.tree_util.tree_unflatten(l_tree, param_arrays[nf + ns:])
+        xs = _microbatch(x_arr, nm)
+        auxs = _microbatch(aux_arr, nm) if aux_arr is not None else None
+        return mapped(fp, sp, lp, xs, auxs)
+
+    from ..core import dispatch
+
+    all_tensors = [x] + f_flat + s_flat + l_flat
+    saved = _dispatch_hidden_meta(all_tensors)
+    try:
+        out = dispatch.call(
+            "pipeline_program", impl,
+            (x,) + tuple(f_flat) + tuple(s_flat) + tuple(l_flat), {},
+        )
+    finally:
+        for t, m in saved:
+            t._dist_meta = m
+    return out
+
+
+# --------------------------------------------------------------------------
+# 1F1B: interleaved forward/backward ticks in one scan, hand-rolled inline
+# backward (recompute-based).  ref: pipeline_parallel.py:575 (dygraph 1F1B)
+# and pipeline_scheduler_pass/pipeline_1f1b.py:45 (static pass). The point
+# of 1F1B is the activation stash bound: a stage holds at most O(n_stages)
+# micro-batches of activations instead of GPipe's num_micro. jax.grad of a
+# scan cannot express that (it saves the whole timeline), so this schedule
+# computes gradients INSIDE the scan: each tick runs one forward micro-step
+# and one backward micro-step (jax.vjp of the stage, recomputed from a
+# 2*n_stages-deep input ring buffer), cotangents ride the reverse ring.
+# Param grads come back as explicit outputs wired to the tape via
+# jax.custom_vjp — the fwd pass of the op IS fwd+bwd (the reference's
+# interleaved scheduler collapsed into one XLA program).
+# --------------------------------------------------------------------------
+
+
+def _pipeline_1f1b_local(first_arrays, stage_arrays, last_arrays, xs, aux,
+                         *, first_fn, stage_fn, last_fn, axis_name,
+                         n_micro, data_axis=None):
+    n_stages = jax.lax.psum(1, axis_name)
+    s_idx = jax.lax.axis_index(axis_name)
+    sp = jax.tree_util.tree_map(lambda p: p[0], stage_arrays)
+    vaxes = (axis_name,) + ((data_axis,) if data_axis is not None else ())
+    # params arrive unvarying along replicated axes; mark them varying so
+    # jax.vjp returns PER-DEVICE partial grads instead of auto-psumming
+    # every device's (mostly masked-garbage) contribution across the mesh —
+    # this schedule does its own masking + explicit psum/pmean at the end
+
+    def to_varying(tree):
+        return jax.tree_util.tree_map(
+            lambda p: jax.lax.pcast(p, vaxes, to="varying"), tree
+        )
+
+    first_arrays = to_varying(first_arrays)
+    last_arrays = to_varying(last_arrays)
+    if data_axis is not None:
+        sp = jax.tree_util.tree_map(
+            lambda p: jax.lax.pcast(p, (data_axis,), to="varying"), sp
+        )
+
+    hidden = jax.eval_shape(first_fn, first_arrays, xs[0])
+    buf_n = 2 * n_stages  # stash bound: ≤ 2(n-1-s)+1 in flight per stage
+
+    def zeros_like_tree(t):
+        return jax.tree_util.tree_map(
+            lambda p: jax.lax.pcast(
+                jnp.zeros(p.shape, p.dtype), vaxes, to="varying"
+            ),
+            t,
+        )
+
+    def zeros_varying(shape, dtype):
+        return jax.lax.pcast(jnp.zeros(shape, dtype), vaxes, to="varying")
+
+    fwd0 = zeros_varying(hidden.shape, hidden.dtype)
+    bwd0 = zeros_varying(hidden.shape, hidden.dtype)
+    buf0 = zeros_varying((buf_n,) + hidden.shape, hidden.dtype)
+    dsp0 = zeros_like_tree(sp)
+    dfp0 = zeros_like_tree(first_arrays)
+    dlp0 = zeros_like_tree(last_arrays)
+    loss0 = zeros_varying((), jnp.float32)
+
+    perm_fwd = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+    perm_bwd = [(j, (j - 1) % n_stages) for j in range(n_stages)]
+
+    def masked_add(acc, inc, valid):
+        return jax.tree_util.tree_map(
+            lambda a, i: a + jnp.where(valid, i, jnp.zeros_like(i)),
+            acc, inc,
+        )
+
+    def tick(state, t):
+        fwd_c, bwd_c, buf, dsp, dfp, dlp, loss_sum = state
+
+        # ---- forward micro-step: F(s, m_f) at t = s + m_f
+        m_f = t - s_idx
+        valid_f = jnp.logical_and(m_f >= 0, m_f < n_micro)
+        mfc = jnp.clip(m_f, 0, n_micro - 1)
+        emb = first_fn(first_arrays, xs[mfc])
+        inp = jnp.where(s_idx == 0, emb, fwd_c)
+        out = stage_fn(sp, inp)
+        slot_f = mfc % buf_n
+        cur = jax.lax.dynamic_index_in_dim(buf, slot_f, 0, keepdims=False)
+        buf = jax.lax.dynamic_update_index_in_dim(
+            buf, jnp.where(valid_f, inp, cur), slot_f, 0
+        )
+
+        # ---- backward micro-step: B(s, m_b) at t = 2(n-1) - s + m_b
+        m_b = t - (2 * (n_stages - 1) - s_idx)
+        valid_b = jnp.logical_and(m_b >= 0, m_b < n_micro)
+        mbc = jnp.clip(m_b, 0, n_micro - 1)
+        slot_b = mbc % buf_n
+        inp_b = jax.lax.dynamic_index_in_dim(
+            buf, slot_b, 0, keepdims=False
+        )
+        out_b, pull = jax.vjp(stage_fn, sp, inp_b)
+        aux_b = aux[mbc] if aux is not None else None
+        loss_m, pull_last = jax.vjp(
+            lambda lp, h: last_fn(lp, h, aux_b), last_arrays, out_b
+        )
+        dlp_inc, dout_last = pull_last(jnp.ones_like(loss_m))
+        is_last = s_idx == n_stages - 1
+        cot_out = jnp.where(is_last, dout_last.astype(hidden.dtype), bwd_c)
+        dsp_inc, dinp = pull(cot_out)
+        # stage-0 edge: push the input cotangent through first_fn
+        _, pull_first = jax.vjp(first_fn, first_arrays, xs[mbc])
+        dfp_inc = pull_first(dinp)[0]
+
+        dsp = masked_add(dsp, dsp_inc, valid_b)
+        dlp = masked_add(dlp, dlp_inc,
+                         jnp.logical_and(valid_b, is_last))
+        dfp = masked_add(dfp, dfp_inc,
+                         jnp.logical_and(valid_b, s_idx == 0))
+        loss_sum = loss_sum + jnp.where(
+            jnp.logical_and(valid_b, is_last),
+            loss_m.astype(jnp.float32), 0.0,
+        )
+
+        fwd_next = jax.lax.ppermute(out, axis_name, perm_fwd)
+        bwd_next = jax.lax.ppermute(dinp, axis_name, perm_bwd)
+        return (fwd_next, bwd_next, buf, dsp, dfp, dlp, loss_sum), None
+
+    total = n_micro + 2 * (n_stages - 1)
+    state0 = (fwd0, bwd0, buf0, dsp0, dfp0, dlp0, loss0)
+    (_, _, _, dsp, dfp, dlp, loss_sum), _ = jax.lax.scan(
+        tick, state0, jnp.arange(total)
+    )
+
+    inv = jnp.float32(1.0 / n_micro)
+    mask = (s_idx == n_stages - 1).astype(jnp.float32)
+    loss = jax.lax.psum(loss_sum * mask, axis_name) * inv
+    # edge grads live on one stage; psum replicates them (zeros elsewhere)
+    dfp = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g * inv.astype(g.dtype), axis_name), dfp)
+    dlp = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g * inv.astype(g.dtype), axis_name), dlp)
+    # stage grads stay per-device; re-grow the leading stage dim
+    dsp = jax.tree_util.tree_map(
+        lambda g: (g * inv.astype(g.dtype))[None], dsp)
+    if data_axis is not None:
+        # DP composition: average loss and all grads across the data axis
+        loss = jax.lax.pmean(loss, data_axis)
+        pm = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda g: jax.lax.pmean(g, data_axis), t)
+        dfp, dsp, dlp = pm(dfp), pm(dsp), pm(dlp)
+    return loss, dfp, dsp, dlp
+
+
+def pipeline_1f1b(first_fn, stage_fn, last_fn, first_params,
+                  stacked_params, last_params, x, aux=None, *,
+                  mesh: ProcessMesh, axis_name="pp",
+                  num_micro_batches=None, data_axis=None, cache=None):
+    """1F1B-scheduled pipelined loss (see module docstring). Same contract
+    as pipeline_program; gradients for first/stacked/last params are
+    computed inline during the forward scan and surfaced to the autograd
+    tape via custom_vjp, so loss.backward() costs nothing extra. x/aux
+    (token ids / labels) are treated as non-differentiable.
+    Bubble fraction: 2(n_stages-1) / (num_micro + 2(n_stages-1))."""
+    n_stages = mesh.get_dim_size(axis_name)
+    nm = num_micro_batches or n_stages
+    if not isinstance(x, Tensor):
+        x = Tensor(x)
+    if aux is not None and not isinstance(aux, Tensor):
+        aux = Tensor(aux)
+    stacked_params = _prep_stacked(stacked_params, mesh, axis_name)
+
+    stacked_spec = jax.tree_util.tree_map(
+        lambda p: PartitionSpec(*([axis_name] + [None] * (p.ndim - 1))),
+        stacked_params, is_leaf=lambda v: isinstance(v, Tensor),
+    )
+    data_spec = PartitionSpec(None, data_axis)  # [nm, mb, ...] mb over dp
+
+    f_flat, f_tree = jax.tree_util.tree_flatten(
+        first_params, is_leaf=lambda v: isinstance(v, Tensor))
+    s_flat, s_tree = jax.tree_util.tree_flatten(
+        stacked_params, is_leaf=lambda v: isinstance(v, Tensor))
+    l_flat, l_tree = jax.tree_util.tree_flatten(
+        last_params, is_leaf=lambda v: isinstance(v, Tensor))
+    nf, ns = len(f_flat), len(s_flat)
+    x_arr = x._data
+    aux_arr = aux._data if aux is not None else None
+
+    ckey = ("1f1b", _shape_key(x, aux, first_params, stacked_params,
+                               last_params), nm, data_axis)
+    mapped = None if cache is None else cache.get(ckey)
+    if mapped is None:
+        local = functools.partial(
+            _pipeline_1f1b_local, first_fn=first_fn, stage_fn=stage_fn,
+            last_fn=last_fn, axis_name=axis_name, n_micro=nm,
+            data_axis=data_axis,
+        )
+        mapped = jax.jit(jax.shard_map(
+            local, mesh=mesh.jax_mesh(),
+            in_specs=(
+                _edge_spec(first_params),
+                stacked_spec,
+                _edge_spec(last_params),
+                data_spec,
+                data_spec if aux_arr is not None else None,
+            ),
+            out_specs=(
+                PartitionSpec(),
+                _edge_spec(first_params),
+                stacked_spec,
+                _edge_spec(last_params),
+            ),
+        ))
+        if cache is not None:
+            cache[ckey] = mapped
+
+    @jax.custom_vjp
+    def core(*param_arrays):
+        return _run(*param_arrays)[0]
+
+    def _run(*param_arrays):
+        fp = jax.tree_util.tree_unflatten(f_tree, param_arrays[:nf])
+        sp = jax.tree_util.tree_unflatten(
+            s_tree, param_arrays[nf:nf + ns])
+        lp = jax.tree_util.tree_unflatten(l_tree, param_arrays[nf + ns:])
+        xs = _microbatch(x_arr, nm)
+        auxs = _microbatch(aux_arr, nm) if aux_arr is not None else None
+        loss, dfp, dsp, dlp = mapped(fp, sp, lp, xs, auxs)
+        grads = (
+            tuple(jax.tree_util.tree_leaves(dfp))
+            + tuple(jax.tree_util.tree_leaves(dsp))
+            + tuple(jax.tree_util.tree_leaves(dlp))
+        )
+        return loss, grads
+
+    def core_fwd(*param_arrays):
+        loss, grads = _run(*param_arrays)
+        return loss, grads
+
+    def core_bwd(grads, ct):
+        return tuple(
+            (ct.astype(g.dtype) * g) if g is not None else None
+            for g in grads
+        )
+
+    core.defvjp(core_fwd, core_bwd)
+
+    from ..core import dispatch
+
+    all_params = f_flat + s_flat + l_flat
+    saved = _dispatch_hidden_meta([x] + all_params)
+    try:
+        out = dispatch.call(
+            "pipeline_1f1b", core, tuple(all_params), {}
+        )
+    finally:
+        for t, m in saved:
+            t._dist_meta = m
+    return out

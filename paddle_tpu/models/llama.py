@@ -325,3 +325,178 @@ class LlamaForCausalLM(GenerationMixin, Layer):
 
     def num_params(self):
         return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+
+# --------------------------------------------------------------------------
+# Pipeline-parallel Llama: maps a LlamaForCausalLM onto the heterogeneous
+# pipeline schedules (distributed/pipeline.py), embedding + head + loss
+# INSIDE the pipelined region.  ref: the reference's PipelineLayer partition
+# of its Llama integration model (fleet/meta_parallel/pp_layers.py:258
+# SegmentLayers "uniform"; test/auto_parallel/hybrid_strategy/
+# semi_auto_parallel_llama_model.py pp branch).
+# --------------------------------------------------------------------------
+
+
+class LlamaPipeline:
+    """Pipelined training step for a Llama decoder.
+
+    Owns stage-stacked COPIES of the model's weights (the reference's
+    PipelineLayer likewise re-owns partitioned segments): `first` holds the
+    embedding, `stages` the decoder blocks grouped `layers/n_stages` per
+    stage, `last` the final norm + lm_head. ``__call__(ids, labels)``
+    returns the causal-LM loss on the autograd tape; train the tensors
+    from ``parameters()``.
+
+        mesh = dist.ProcessMesh([[0,1],[2,3]], dim_names=["dp","pp"]) ...
+        pipe = LlamaPipeline(model, mesh, schedule="1f1b")
+        loss = pipe(ids, labels); loss.backward(); opt.step()
+    """
+
+    def __init__(self, model, mesh, axis_name="pp", num_micro_batches=None,
+                 schedule="1f1b", remat=False, data_axis=None):
+        from ..core.tensor import Tensor as _T
+
+        cfg = model.config
+        if cfg.num_experts > 0:
+            raise NotImplementedError(
+                "LlamaPipeline: MoE layers not supported (use EP)"
+            )
+        if cfg.tie_word_embeddings:
+            raise NotImplementedError(
+                "LlamaPipeline: tied embeddings not supported; the edge "
+                "stages own separate embed/head weights"
+            )
+        if schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"unknown schedule {schedule!r}")
+        if schedule == "1f1b" and remat:
+            raise ValueError(
+                "remat applies to the gpipe schedule only; 1F1B is "
+                "inherently recompute-based (stages re-run in its "
+                "backward micro-steps)"
+            )
+        n_stages = mesh.get_dim_size(axis_name)
+        L = cfg.num_hidden_layers
+        if L % n_stages:
+            raise ValueError(
+                f"num_hidden_layers {L} not divisible by {n_stages} stages"
+            )
+        self.cfg = cfg
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.num_micro_batches = num_micro_batches
+        self.schedule = schedule
+        self.remat = remat
+        self.data_axis = data_axis
+        # caller-owned compile cache: the pipeline re-uses one jitted
+        # program per shape across training steps
+        self._compile_cache = {}
+        lps = L // n_stages
+
+        def stk(get):
+            arrs = [np.asarray(get(model.llama.layers[i]).numpy())
+                    for i in range(L)]
+            a = np.stack(arrs).reshape((n_stages, lps) + arrs[0].shape)
+            t = _T(a)
+            t.stop_gradient = False
+            return t
+
+        self.stages = {
+            "ln1": stk(lambda l: l.input_layernorm.weight),
+            "wq": stk(lambda l: l.self_attn.q_proj.weight),
+            "wk": stk(lambda l: l.self_attn.k_proj.weight),
+            "wv": stk(lambda l: l.self_attn.v_proj.weight),
+            "wo": stk(lambda l: l.self_attn.o_proj.weight),
+            "ln2": stk(lambda l: l.post_attention_layernorm.weight),
+            "wg": stk(lambda l: l.mlp.gate_proj.weight),
+            "wu": stk(lambda l: l.mlp.up_proj.weight),
+            "wd": stk(lambda l: l.mlp.down_proj.weight),
+        }
+
+        def own(t):
+            c = _T(np.asarray(t.numpy()))
+            c.stop_gradient = False
+            return c
+
+        self.first = {"embed": own(model.llama.embed_tokens.weight)}
+        self.last = {
+            "norm": own(model.llama.norm.weight),
+            "head": own(model.lm_head.weight),
+        }
+
+        eps = cfg.rms_norm_eps
+        theta = cfg.rope_theta
+        n_heads = cfg.num_attention_heads
+        n_kv = cfg.num_key_value_heads
+        hd = cfg.hidden_size // n_heads
+
+        from ..ops.impl.activation import swiglu as _swiglu
+        from ..ops.impl.fused_ops import rope_qk as _rope
+        from ..ops.impl.nn_ops import (
+            rms_norm as _rms,
+            scaled_dot_product_attention as _sdpa,
+        )
+        import jax
+        import jax.numpy as jnp
+
+        def block(bp, h):
+            x = _rms(h, bp["ln1"], epsilon=eps)
+            b, s = x.shape[0], x.shape[1]
+            q = (x @ bp["wq"]).reshape(b, s, n_heads, hd)
+            k = (x @ bp["wk"]).reshape(b, s, n_kv, hd)
+            v = (x @ bp["wv"]).reshape(b, s, n_kv, hd)
+            q, k = _rope(q, k, base=theta)
+            if n_kv != n_heads:
+                rep = n_heads // n_kv
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            o = _sdpa(q, k, v, is_causal=True)
+            h = h + o.reshape(b, s, n_heads * hd) @ bp["wo"]
+            x = _rms(h, bp["ln2"], epsilon=eps)
+            h = h + _swiglu(x @ bp["wg"], x @ bp["wu"]) @ bp["wd"]
+            return h
+
+        def stage_fn(sp, h):
+            h, _ = jax.lax.scan(
+                lambda hh, bp: (block(bp, hh), None), h, sp
+            )
+            return h
+
+        def first_fn(fp, ids):
+            return fp["embed"][ids]
+
+        def last_fn(lp, h, labels):
+            h = _rms(h, lp["norm"], epsilon=eps)
+            logits = h[:, :-1] @ lp["head"]
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            ll = jnp.take_along_axis(
+                logp, labels[:, 1:][..., None].astype(jnp.int32), axis=-1
+            )
+            return -ll.mean()
+
+        self._fns = (first_fn, stage_fn, last_fn)
+
+    def __call__(self, input_ids, labels):
+        from ..distributed.pipeline import pipeline_1f1b, pipeline_program
+
+        first_fn, stage_fn, last_fn = self._fns
+        kw = dict(
+            mesh=self.mesh, axis_name=self.axis_name,
+            num_micro_batches=self.num_micro_batches,
+            data_axis=self.data_axis, cache=self._compile_cache,
+        )
+        if self.schedule == "1f1b":
+            return pipeline_1f1b(
+                first_fn, stage_fn, last_fn, self.first, self.stages,
+                self.last, input_ids, labels, **kw,
+            )
+        return pipeline_program(
+            first_fn, stage_fn, last_fn, self.first, self.stages,
+            self.last, input_ids, labels, remat=self.remat, **kw,
+        )
+
+    def parameters(self):
+        return (
+            list(self.first.values())
+            + list(self.stages.values())
+            + list(self.last.values())
+        )
