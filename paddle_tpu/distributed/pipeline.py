@@ -233,11 +233,42 @@ def _shape_key(*trees):
         for leaf in jax.tree_util.tree_leaves(
             t, is_leaf=lambda v: isinstance(v, Tensor)
         ):
-            if isinstance(leaf, Tensor):
-                leaves.append((tuple(leaf.shape), str(leaf.dtype)))
-            elif hasattr(leaf, "shape"):
+            if hasattr(leaf, "shape"):
                 leaves.append((tuple(leaf.shape), str(leaf.dtype)))
     return tuple(leaves)
+
+
+def _pipeline_scaffold(first_params, stacked_params, last_params,
+                       mesh, axis_name, data_axis):
+    """Shared plumbing for both schedules: shard stacked params, build
+    specs, flatten the three param trees."""
+    stacked_params = _prep_stacked(stacked_params, mesh, axis_name)
+    stacked_spec = jax.tree_util.tree_map(
+        lambda p: PartitionSpec(*([axis_name] + [None] * (p.ndim - 1))),
+        stacked_params, is_leaf=lambda v: isinstance(v, Tensor),
+    )
+    data_spec = PartitionSpec(None, data_axis)  # [nm, mb, ...] mb over dp
+    f_flat, f_tree = jax.tree_util.tree_flatten(
+        first_params, is_leaf=lambda v: isinstance(v, Tensor))
+    s_flat, s_tree = jax.tree_util.tree_flatten(
+        stacked_params, is_leaf=lambda v: isinstance(v, Tensor))
+    l_flat, l_tree = jax.tree_util.tree_flatten(
+        last_params, is_leaf=lambda v: isinstance(v, Tensor))
+    return (stacked_params, stacked_spec, data_spec,
+            (f_flat, f_tree), (s_flat, s_tree), (l_flat, l_tree))
+
+
+def _dispatch_pipeline(op_name, impl, tensors, args):
+    """Strip dist metadata, run the op through the generic dispatcher,
+    restore metadata."""
+    from ..core import dispatch
+
+    saved = _dispatch_hidden_meta(tensors)
+    try:
+        return dispatch.call(op_name, impl, args, {})
+    finally:
+        for t, m in saved:
+            t._dist_meta = m
 
 
 def _pipeline_lm_local(first_arrays, stage_arrays, last_arrays, xs, aux,
@@ -357,13 +388,11 @@ def pipeline_program(first_fn, stage_fn, last_fn, first_params,
         x = Tensor(x)
     if aux is not None and not isinstance(aux, Tensor):
         aux = Tensor(aux)
-    stacked_params = _prep_stacked(stacked_params, mesh, axis_name)
-
-    stacked_spec = jax.tree_util.tree_map(
-        lambda p: PartitionSpec(*([axis_name] + [None] * (p.ndim - 1))),
-        stacked_params, is_leaf=lambda v: isinstance(v, Tensor),
+    (stacked_params, stacked_spec, data_spec,
+     (f_flat, f_tree), (s_flat, s_tree), (l_flat, l_tree)) = (
+        _pipeline_scaffold(first_params, stacked_params, last_params,
+                           mesh, axis_name, data_axis)
     )
-    data_spec = PartitionSpec(None, data_axis)  # [nm, mb, ...] mb over dp
     ckey = ("gpipe", _shape_key(x, aux, first_params, stacked_params,
                                 last_params), nm, remat, data_axis)
     mapped = None if cache is None else cache.get(ckey)
@@ -387,12 +416,6 @@ def pipeline_program(first_fn, stage_fn, last_fn, first_params,
         if cache is not None:
             cache[ckey] = mapped
 
-    f_flat, f_tree = jax.tree_util.tree_flatten(
-        first_params, is_leaf=lambda v: isinstance(v, Tensor))
-    s_flat, s_tree = jax.tree_util.tree_flatten(
-        stacked_params, is_leaf=lambda v: isinstance(v, Tensor))
-    l_flat, l_tree = jax.tree_util.tree_flatten(
-        last_params, is_leaf=lambda v: isinstance(v, Tensor))
     nf, ns = len(f_flat), len(s_flat)
     aux_arr = aux._data if aux is not None else None
 
@@ -405,19 +428,10 @@ def pipeline_program(first_fn, stage_fn, last_fn, first_params,
         auxs = _microbatch(aux_arr, nm) if aux_arr is not None else None
         return mapped(fp, sp, lp, xs, auxs)
 
-    from ..core import dispatch
-
-    all_tensors = [x] + f_flat + s_flat + l_flat
-    saved = _dispatch_hidden_meta(all_tensors)
-    try:
-        out = dispatch.call(
-            "pipeline_program", impl,
-            (x,) + tuple(f_flat) + tuple(s_flat) + tuple(l_flat), {},
-        )
-    finally:
-        for t, m in saved:
-            t._dist_meta = m
-    return out
+    return _dispatch_pipeline(
+        "pipeline_program", impl, [x] + f_flat + s_flat + l_flat,
+        (x,) + tuple(f_flat) + tuple(s_flat) + tuple(l_flat),
+    )
 
 
 # --------------------------------------------------------------------------
@@ -584,20 +598,11 @@ def pipeline_1f1b(first_fn, stage_fn, last_fn, first_params,
         x = Tensor(x)
     if aux is not None and not isinstance(aux, Tensor):
         aux = Tensor(aux)
-    stacked_params = _prep_stacked(stacked_params, mesh, axis_name)
-
-    stacked_spec = jax.tree_util.tree_map(
-        lambda p: PartitionSpec(*([axis_name] + [None] * (p.ndim - 1))),
-        stacked_params, is_leaf=lambda v: isinstance(v, Tensor),
+    (stacked_params, stacked_spec, data_spec,
+     (f_flat, f_tree), (s_flat, s_tree), (l_flat, l_tree)) = (
+        _pipeline_scaffold(first_params, stacked_params, last_params,
+                           mesh, axis_name, data_axis)
     )
-    data_spec = PartitionSpec(None, data_axis)  # [nm, mb, ...] mb over dp
-
-    f_flat, f_tree = jax.tree_util.tree_flatten(
-        first_params, is_leaf=lambda v: isinstance(v, Tensor))
-    s_flat, s_tree = jax.tree_util.tree_flatten(
-        stacked_params, is_leaf=lambda v: isinstance(v, Tensor))
-    l_flat, l_tree = jax.tree_util.tree_flatten(
-        last_params, is_leaf=lambda v: isinstance(v, Tensor))
     nf, ns = len(f_flat), len(s_flat)
     x_arr = x._data
     aux_arr = aux._data if aux is not None else None
@@ -661,15 +666,7 @@ def pipeline_1f1b(first_fn, stage_fn, last_fn, first_params,
 
     core.defvjp(core_fwd, core_bwd)
 
-    from ..core import dispatch
-
-    all_params = f_flat + s_flat + l_flat
-    saved = _dispatch_hidden_meta([x] + all_params)
-    try:
-        out = dispatch.call(
-            "pipeline_1f1b", core, tuple(all_params), {}
-        )
-    finally:
-        for t, m in saved:
-            t._dist_meta = m
-    return out
+    return _dispatch_pipeline(
+        "pipeline_1f1b", core, [x] + f_flat + s_flat + l_flat,
+        tuple(f_flat) + tuple(s_flat) + tuple(l_flat),
+    )

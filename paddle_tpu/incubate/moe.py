@@ -4,13 +4,15 @@ ref: python/paddle/incubate/distributed/models/moe/moe_layer.py:263
 (MoELayer + gshard/switch gates over global_scatter/global_gather a2a
 ops) and phi/kernels/fusion/cutlass/fused_moe_kernel.cu.
 
-TPU-first re-design: instead of materialized all-to-all scatter/gather
-ops, routing uses the GShard dense-dispatch einsum formulation —
-dispatch/combine tensors contracted against stacked expert weights
-[E, ...]. Under GSPMD, sharding the expert dim E over the 'ep' mesh axis
-turns those einsums into exactly the a2a dispatch/combine collectives the
-reference launches by hand, and the expert FFN becomes a grouped GEMM on
-each chip's local experts.
+TPU-first re-design: routing is SORT-BASED (ops/impl/moe_ops.py):
+top-k + stable argsort by expert id builds an [e, capacity, m] buffer
+with one scatter and reads it back with one gather — O(s*k*m) routing
+memory instead of the dense GShard one-hot formulation's O(s*e*c)
+dispatch/combine tensors (which this layer used before, and which
+TopKGate.forward still provides for compatibility). The expert FFN is a
+grouped GEMM over the stacked [E, ...] weights; sharding E over an 'ep'
+mesh axis makes GSPMD insert the dispatch/combine all-to-alls the
+reference launches by hand (global_scatter/global_gather).
 """
 from __future__ import annotations
 
@@ -142,11 +144,39 @@ class MoELayer(Layer):
             num_experts, d_model, d_ff or 4 * d_model
         )
 
-    def forward(self, x):
+    def forward(self, x, return_stats=False):
+        """[b, s, m] -> ([b, s, m], aux_loss). With return_stats=True a
+        third dict carries token-drop counters (host diagnostics; do not
+        request inside a staged TrainStep).
+
+        A stock TopKGate routes through the sort-based fast path. A
+        custom ``gate=`` (including TopKGate subclasses overriding
+        forward) keeps the documented dense contract: its forward is
+        called for (dispatch [s,e,c], combine [s,e,c], aux)."""
         b, s, m = x.shape
         flat = F.reshape(x, [b * s, m])
-        dispatch, combine, aux = self.gate(flat)
-        dispatched = F.einsum("sec,sm->ecm", dispatch, flat)
+        if type(self.gate) is not TopKGate:
+            dispatch, combine, aux = self.gate(flat)
+            dispatched = F.einsum("sec,sm->ecm", dispatch, flat)
+            expert_out = self.experts(dispatched)
+            out = F.einsum("sec,ecm->sm", combine, expert_out)
+            if return_stats:
+                return F.reshape(out, [b, s, m]), aux, {}
+            return F.reshape(out, [b, s, m]), aux
+        logits = F.matmul(flat, self.gate.weight)
+        cap = self.gate.capacity(b * s)
+        dispatched, cw, eids, slots, aux, n_drop = F.moe_gate_dispatch(
+            flat, logits, k=self.gate.k, capacity=cap
+        )
         expert_out = self.experts(dispatched)
-        out = F.einsum("sec,ecm->sm", combine, expert_out)
-        return F.reshape(out, [b, s, m]), aux
+        out = F.moe_combine(expert_out, cw, eids, slots)
+        out = F.reshape(out, [b, s, m])
+        if return_stats:
+            total = b * s * self.gate.k
+            stats = {
+                "dropped_assignments": n_drop,
+                "total_assignments": total,
+                "capacity": cap,
+            }
+            return out, aux, stats
+        return out, aux

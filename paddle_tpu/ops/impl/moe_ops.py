@@ -1,0 +1,95 @@
+"""Sort-based MoE dispatch/combine.
+
+ref: the reference's moe_gate_dispatch op (phi/infermeta/spmd_rules/
+moe_gate_dispatch.cc, phi/kernels/moe_gate_dispatch_kernel.h) and the
+expert-sorted row layout of fusion/cutlass/fused_moe_kernel.cu (tokens
+permuted so each expert's rows are contiguous, then grouped GEMMs).
+
+TPU form: everything static-shape so it stages — top_k + stable argsort
+by expert id + searchsorted segment starts replace the CUDA kernel's
+atomic counters; the [e, capacity, m] buffer is built with one scatter
+(unique indices, out-of-bounds rows dropped), and combine is one gather.
+Routing cost is O(s*k*m + s*e) memory instead of the dense GShard
+one-hot formulation's O(s*e*c) dispatch/combine tensors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_gate_dispatch(x, gate_logits, *, k=2, capacity=0,
+                      renormalize=True):
+    """Route tokens to experts, expert-sorted.
+
+    x: [s, m] tokens; gate_logits: [s, e].
+    Returns (dispatched [e, c, m], combine_weights [s, k],
+    expert_ids [s, k] int32, slots [s, k] int32 (-1 = dropped),
+    aux_loss scalar, n_dropped scalar int32).
+
+    An explicit capacity is honored EXACTLY (the caller's load-
+    regularization contract). capacity == 0 means "dropless for balanced
+    loads": c = ceil(s*k/e) rounded up to a multiple of 8 (sublane tile).
+    Tokens past an expert's capacity are dropped (slot -1, weight 0) —
+    the reference's capacity semantics.
+    """
+    s, m = x.shape
+    e = gate_logits.shape[-1]
+    gates = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    vals, idx = jax.lax.top_k(gates, k)               # [s, k]
+    if renormalize:
+        vals = vals / (vals.sum(-1, keepdims=True) + 1e-9)
+    if capacity:
+        c = int(capacity)
+    else:
+        c = -(-(s * k) // e)
+        c = max(8, -(-c // 8) * 8)
+
+    flat_e = idx.reshape(-1).astype(jnp.int32)        # [s*k]
+    order = jnp.argsort(flat_e, stable=True)          # expert-sorted
+    sorted_e = flat_e[order]
+    seg_start = jnp.searchsorted(
+        sorted_e, jnp.arange(e, dtype=sorted_e.dtype), side="left"
+    )
+    pos_within = jnp.arange(s * k, dtype=jnp.int32) - seg_start[sorted_e]
+    keep = pos_within < c
+
+    tok = order // k                                  # token per assignment
+    # OOB expert index -> scatter drops the row (capacity overflow)
+    esc = jnp.where(keep, sorted_e, e)
+    psc = jnp.where(keep, pos_within, c)
+    dispatched = jnp.zeros((e, c, m), x.dtype).at[esc, psc].set(
+        x[tok], mode="drop"
+    )
+
+    # map each (token, k) assignment back to its slot (-1 = dropped)
+    slot_sorted = jnp.where(keep, pos_within, -1).astype(jnp.int32)
+    slots = (
+        jnp.full((s * k,), -1, jnp.int32).at[order].set(slot_sorted)
+    ).reshape(s, k)
+
+    # GShard load-balancing aux: e * sum(mean_gate * assigned_fraction)
+    me = gates.mean(0)                                # [e]
+    ce = jnp.zeros((e,), jnp.float32).at[esc].add(
+        jnp.where(keep, 1.0 / s, 0.0), mode="drop"
+    )
+    aux = jnp.sum(me * ce) * float(e)
+    n_dropped = jnp.sum(~keep).astype(jnp.int32)
+    return (dispatched, vals.astype(x.dtype), idx.astype(jnp.int32),
+            slots, aux, n_dropped)
+
+
+def moe_combine(expert_out, combine_weights, expert_ids, slots):
+    """Inverse of moe_gate_dispatch: gather each assignment's expert
+    output and weight it; dropped assignments (slot -1) contribute 0.
+
+    expert_out: [e, c, m]; combine_weights/expert_ids/slots: [s, k].
+    Returns [s, m]."""
+    e, c, m = expert_out.shape
+    s, k = expert_ids.shape
+    safe = jnp.maximum(slots, 0).reshape(-1)
+    rows = expert_out[expert_ids.reshape(-1), safe]   # [s*k, m]
+    w = (
+        combine_weights * (slots >= 0).astype(combine_weights.dtype)
+    ).reshape(-1, 1)
+    return (rows * w.astype(rows.dtype)).reshape(s, k, m).sum(1)

@@ -12,7 +12,7 @@ from paddle_tpu.distributed.sequence_parallel import (
     ring_attention,
     split_sequence,
 )
-from paddle_tpu.incubate import MoELayer
+from paddle_tpu.incubate import MoELayer, TopKGate
 
 
 @pytest.fixture(scope="module")
@@ -292,3 +292,99 @@ class TestPipelineParallel:
                 stage_fn, (paddle.to_tensor(W), paddle.to_tensor(B)),
                 paddle.to_tensor(x[:15]), mesh=mesh, num_micro_batches=4,
             )
+
+
+class TestSortBasedDispatch:
+    """moe_gate_dispatch/moe_combine (sort-based routing) vs the dense
+    GShard one-hot oracle that TopKGate.forward still provides."""
+
+    def test_matches_dense_dispatch_when_nothing_drops(self):
+        import paddle_tpu.ops as F
+
+        paddle.seed(0)
+        moe = MoELayer(d_model=16, num_experts=4, d_ff=32, k=2,
+                       capacity_factor=4.0)  # no drops
+        x_np = np.random.RandomState(0).randn(1, 8, 16).astype(np.float32)
+        out_sorted, _ = moe(paddle.to_tensor(x_np))
+
+        # dense oracle via the legacy TopKGate path
+        flat = paddle.to_tensor(x_np.reshape(8, 16))
+        dispatch, combine, _ = moe.gate(flat)
+        dispatched = F.einsum("sec,sm->ecm", dispatch, flat)
+        expert_out = moe.experts(dispatched)
+        out_dense = F.einsum("sec,ecm->sm", combine, expert_out)
+        np.testing.assert_allclose(
+            out_sorted.numpy().reshape(8, 16), out_dense.numpy(),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_drop_stats_and_capacity(self):
+        paddle.seed(0)
+        moe = MoELayer(d_model=8, num_experts=2, d_ff=16, k=1,
+                       capacity_factor=0.5)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(1, 16, 8).astype(np.float32)
+        )
+        out, aux, stats = moe(x, return_stats=True)
+        assert out.shape == [1, 16, 8]
+        assert stats["total_assignments"] == 16
+        # capacity = ceil(0.5 * 1 * 16 / 2) = 4 slots/expert, honored
+        # exactly -> at most 8 of 16 assignments fit
+        assert stats["capacity"] == 4
+        assert int(stats["dropped_assignments"].numpy()) >= 8
+
+    def test_dropped_tokens_pass_through_as_zero(self):
+        import paddle_tpu.ops as F
+
+        # capacity 0 is rounded up to 8 slots; with 32 tokens k=1 routed
+        # to ONE expert (identical logits via zero weight), 24 drop
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(32, 4).astype(np.float32)
+        )
+        logits = paddle.to_tensor(
+            np.tile(np.array([[5.0, 0.0]], np.float32), (32, 1))
+        )
+        d, cw, eids, slots, aux, nd = F.moe_gate_dispatch(
+            x, logits, k=1, capacity=8
+        )
+        assert int(nd.numpy()) == 24
+        assert (slots.numpy() >= 0).sum() == 8
+        out = F.moe_combine(d, cw, eids, slots)
+        got = out.numpy()
+        kept = slots.numpy()[:, 0] >= 0
+        assert np.allclose(got[~kept], 0.0)
+        assert not np.allclose(got[kept], 0.0)
+
+    def test_custom_gate_keeps_dense_contract(self):
+        """gate= injection (incl. TopKGate subclasses overriding forward)
+        must route through the injected gate's forward."""
+        calls = []
+
+        class MyGate(TopKGate):
+            def forward(self, x):
+                calls.append(1)
+                return super().forward(x)
+
+        paddle.seed(0)
+        moe = MoELayer(d_model=8, num_experts=2, d_ff=16,
+                       gate=MyGate(8, 2, k=2, capacity_factor=4.0))
+        x = paddle.to_tensor(
+            np.random.RandomState(5).randn(1, 4, 8).astype(np.float32)
+        )
+        out, aux = moe(x)
+        assert calls, "injected gate.forward was never invoked"
+        assert out.shape == [1, 4, 8]
+
+    def test_gradients_flow_through_routing(self):
+        paddle.seed(0)
+        moe = MoELayer(d_model=8, num_experts=2, d_ff=16, k=2)
+        x = paddle.to_tensor(
+            np.random.RandomState(3).randn(1, 4, 8).astype(np.float32)
+        )
+        x.stop_gradient = False
+        out, aux = moe(x)
+        (out.sum() + 0.01 * aux).backward()
+        assert x.grad is not None
+        assert all(p.grad is not None for p in moe.parameters())
+        # gate weight gets grads through combine weights AND aux loss
+        assert float(np.abs(moe.gate.weight.grad.numpy()).max()) > 0
