@@ -54,6 +54,15 @@ from . import framework  # noqa: E402
 from . import device  # noqa: E402
 from . import distributed  # noqa: E402
 from . import distribution  # noqa: E402
+
+# `fft` is both a generated op (bound by the ops glob above) and a
+# namespace module; `from . import fft` would resolve to the existing
+# function attribute without importing the submodule, so import it
+# explicitly — paddle.fft is the MODULE (reference parity), the function
+# stays reachable as paddle.fft.fft / ops.fft
+import importlib as _importlib  # noqa: E402
+
+fft = _importlib.import_module(__name__ + ".fft")
 from . import geometric  # noqa: E402
 from . import hapi  # noqa: E402
 from . import incubate  # noqa: E402

@@ -52,17 +52,57 @@ def _tree_flatten_tensors(args):
     )
 
 
+def _nan_inf_report(bad, name, level):
+    """Host-side reaction to a detected NaN/Inf (shared by the eager and
+    staged paths)."""
+    if bad:
+        msg = f"NaN/Inf detected in output of op '{name}'"
+        if level >= 3:
+            print(f"[check_nan_inf] {msg}")
+        else:
+            raise FloatingPointError(msg)
+
+
+# Active NaN-flag collector: installed by jit.StaticFunction/TrainStep
+# while tracing so per-op isfinite reductions become explicit program
+# OUTPUTS (checked by the host wrapper after execution). Pure dataflow —
+# works on PJRT backends without host-callback support (axon).
+_nan_collector: list | None = None
+
+
+def set_nan_collector(collector):
+    """Install (or clear, with None) the staged NaN-flag collector.
+    Returns the previous collector for restoration."""
+    global _nan_collector
+    prev = _nan_collector
+    _nan_collector = collector
+    return prev
+
+
 def _check_nan_inf(name, arrays):
+    """ref: fluid/framework/new_executor/nan_inf_utils.cc — the
+    reference's check runs in BOTH its eager and static executors. Three
+    paths here: concrete arrays check immediately (eager); tracers under
+    an installed collector record (op_name, bad_flag) pairs that the
+    staging wrapper returns as program outputs (TrainStep/StaticFunction);
+    tracers outside any collector (user's own jax.jit) fall back to a
+    host debug callback where the backend supports one."""
     level = flags.get_flag("FLAGS_check_nan_inf_level")
     for a in arrays:
         if jnp.issubdtype(a.dtype, jnp.floating):
-            bad = bool(jnp.logical_not(jnp.all(jnp.isfinite(a))))
-            if bad:
-                msg = f"NaN/Inf detected in output of op '{name}'"
-                if level >= 3:
-                    print(f"[check_nan_inf] {msg}")
+            bad = jnp.logical_not(jnp.all(jnp.isfinite(a)))
+            if isinstance(bad, jax.core.Tracer):
+                if _nan_collector is not None:
+                    _nan_collector.append((name, bad))
                 else:
-                    raise FloatingPointError(msg)
+                    jax.debug.callback(
+                        lambda b, _n=name, _l=level: _nan_inf_report(
+                            bool(b), _n, _l
+                        ),
+                        bad,
+                    )
+            else:
+                _nan_inf_report(bool(bad), name, level)
 
 
 def call(op_name: str, impl: Callable, args: tuple, attrs: dict[str, Any]):

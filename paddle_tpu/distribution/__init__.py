@@ -5,8 +5,19 @@ tested against scipy in test/distribution).
 TPU-first: sampling draws keys from the framework RNG at wrapper level and
 runs jnp math (traceable under jit); math accumulates in the input dtype.
 """
+from . import transform  # noqa: F401
 from .distributions import (
     Bernoulli,
+    Binomial,
+    Cauchy,
+    Chi2,
+    ContinuousBernoulli,
+    Geometric,
+    Independent,
+    MultivariateNormal,
+    Poisson,
+    StudentT,
+    TransformedDistribution,
     Beta,
     Categorical,
     Dirichlet,
@@ -26,5 +37,8 @@ from .distributions import (
 __all__ = [
     "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
     "Exponential", "Laplace", "LogNormal", "Gumbel", "Beta", "Gamma",
-    "Dirichlet", "Multinomial", "kl_divergence", "register_kl",
+    "Dirichlet", "Multinomial", "Poisson", "Geometric", "Binomial",
+    "Cauchy", "Chi2", "StudentT", "ContinuousBernoulli",
+    "MultivariateNormal", "Independent", "TransformedDistribution",
+    "transform", "kl_divergence", "register_kl",
 ]

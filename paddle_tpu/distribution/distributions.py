@@ -704,3 +704,448 @@ def _kl_exponential(p, q):
         lambda pr, qr: jnp.log(pr) - jnp.log(qr) + qr / pr - 1,
         p._rate, q._rate,
     )
+
+
+# ---------------------------------------------------------------------------
+# round-out distributions (ref: python/paddle/distribution/{poisson,
+# geometric,binomial,cauchy,chi2,student_t,continuous_bernoulli,
+# multivariate_normal,independent,transformed_distribution}.py)
+# ---------------------------------------------------------------------------
+
+
+class Poisson(Distribution):
+    """ref: distribution/poisson.py."""
+
+    def __init__(self, rate, name=None):
+        self._rate = rate
+        self.rate = _arr(rate)
+        super().__init__(jnp.shape(self.rate))
+
+    @property
+    def mean(self):
+        return _wrap(self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.rate)
+
+    def sample(self, shape=()):
+        out = jax.random.poisson(
+            split_key(), self.rate, _shape_of(shape, self._rate)
+        )
+        return _wrap(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        return _traced(
+            "poisson_log_prob",
+            lambda r, v: v * jnp.log(r) - r - jax.scipy.special.gammaln(
+                v + 1.0
+            ),
+            self._rate, value,
+        )
+
+    def entropy(self):
+        # small rates: exact finite sum -sum_k p_k log p_k over a static
+        # support (tail beyond k=64 is negligible for rate < 16); large
+        # rates: the standard asymptotic series
+        def fn(r):
+            ks = jnp.arange(64.0)
+            shp = ks.reshape((64,) + (1,) * jnp.ndim(r))
+            logp = (
+                shp * jnp.log(r) - r - jax.scipy.special.gammaln(shp + 1.0)
+            )
+            exact = -jnp.sum(jnp.exp(logp) * logp, axis=0)
+            series = (
+                0.5 * jnp.log(2 * math.pi * math.e * r)
+                - 1 / (12 * r) - 1 / (24 * r ** 2)
+            )
+            return jnp.where(r < 16.0, exact, series)
+
+        return _traced("poisson_entropy", fn, self._rate)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (ref: distribution/geometric.py)."""
+
+    def __init__(self, probs, name=None):
+        self._probs = probs
+        self.probs = _arr(probs)
+        super().__init__(jnp.shape(self.probs))
+
+    @property
+    def mean(self):
+        return _wrap((1.0 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return _wrap((1.0 - self.probs) / jnp.square(self.probs))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(
+            split_key(), _shape_of(shape, self._probs),
+            minval=1e-7, maxval=1.0,
+        )
+        return _wrap(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        return _traced(
+            "geometric_log_prob",
+            lambda p, v: v * jnp.log1p(-p) + jnp.log(p),
+            self._probs, value,
+        )
+
+    def entropy(self):
+        return _traced(
+            "geometric_entropy",
+            lambda p: (-(1 - p) * jnp.log1p(-p) - p * jnp.log(p)) / p,
+            self._probs,
+        )
+
+
+class Binomial(Distribution):
+    """ref: distribution/binomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self._probs = probs
+        self.total_count = _arr(total_count)
+        self.probs = _arr(probs)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.total_count), jnp.shape(self.probs)
+        ))
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        out = jax.random.binomial(
+            split_key(), self.total_count, self.probs,
+            _shape_of(shape, self.total_count, self._probs),
+        )
+        return _wrap(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        def fn(p, v):
+            n = self.total_count
+            logc = (
+                jax.scipy.special.gammaln(n + 1)
+                - jax.scipy.special.gammaln(v + 1)
+                - jax.scipy.special.gammaln(n - v + 1)
+            )
+            return logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+
+        return _traced("binomial_log_prob", fn, self._probs, value)
+
+
+class Cauchy(Distribution):
+    """ref: distribution/cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self._loc, self._scale = loc, scale
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.loc), jnp.shape(self.scale)
+        ))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        eps = jax.random.cauchy(
+            split_key(), _shape_of(shape, self._loc, self._scale)
+        )
+        return _traced(
+            "cauchy_rsample", lambda l, s: l + s * eps,
+            self._loc, self._scale,
+        )
+
+    def log_prob(self, value):
+        return _traced(
+            "cauchy_log_prob",
+            lambda l, s, v: -jnp.log(math.pi * s)
+            - jnp.log1p(jnp.square((v - l) / s)),
+            self._loc, self._scale, value,
+        )
+
+    def entropy(self):
+        return _traced(
+            "cauchy_entropy",
+            lambda s: jnp.log(4 * math.pi * s),
+            self._scale,
+        )
+
+
+class Chi2(Distribution):
+    """Gamma(df/2, rate=1/2) (ref: distribution/chi2.py)."""
+
+    def __init__(self, df, name=None):
+        self._df = df
+        self.df = _arr(df)
+        super().__init__(jnp.shape(self.df))
+
+    @property
+    def mean(self):
+        return _wrap(self.df)
+
+    @property
+    def variance(self):
+        return _wrap(2.0 * self.df)
+
+    def sample(self, shape=()):
+        out = 2.0 * jax.random.gamma(
+            split_key(), self.df / 2.0, _shape_of(shape, self._df)
+        )
+        return _wrap(out)
+
+    def log_prob(self, value):
+        return _traced(
+            "chi2_log_prob",
+            lambda d, v: (d / 2 - 1) * jnp.log(v) - v / 2
+            - (d / 2) * math.log(2.0) - jax.scipy.special.gammaln(d / 2),
+            self._df, value,
+        )
+
+
+class StudentT(Distribution):
+    """ref: distribution/student_t.py."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self._df, self._loc, self._scale = df, loc, scale
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.df), jnp.shape(self.loc), jnp.shape(self.scale)
+        ))
+
+    def sample(self, shape=()):
+        t = jax.random.t(
+            split_key(), self.df,
+            _shape_of(shape, self._df, self._loc, self._scale),
+        )
+        return _traced(
+            "student_t_sample", lambda l, s: l + s * t,
+            self._loc, self._scale,
+        )
+
+    def log_prob(self, value):
+        def fn(d, l, s, v):
+            z = (v - l) / s
+            return (
+                jax.scipy.special.gammaln((d + 1) / 2)
+                - jax.scipy.special.gammaln(d / 2)
+                - 0.5 * jnp.log(d * math.pi) - jnp.log(s)
+                - (d + 1) / 2 * jnp.log1p(jnp.square(z) / d)
+            )
+
+        return _traced(
+            "student_t_log_prob", fn,
+            self._df, self._loc, self._scale, value,
+        )
+
+
+class ContinuousBernoulli(Distribution):
+    """ref: distribution/continuous_bernoulli.py (normalizing constant
+    C(p) = 2*atanh(1-2p) / (1-2p), taylor-stabilized near p=1/2)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self._probs = probs
+        self.probs = _arr(probs)
+        self._lims = lims
+        super().__init__(jnp.shape(self.probs))
+
+    def _log_C(self, p):
+        safe = jnp.where(
+            (p < self._lims[0]) | (p > self._lims[1]), p, 0.25
+        )
+        logc = jnp.log(
+            2 * jnp.arctanh(1 - 2 * safe) / (1 - 2 * safe)
+        )
+        # 2nd-order taylor around 1/2: log C ~= log 2 + 4/3 (p-1/2)^2
+        taylor = math.log(2.0) + 4.0 / 3.0 * jnp.square(p - 0.5)
+        return jnp.where(
+            (p < self._lims[0]) | (p > self._lims[1]), logc, taylor
+        )
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(
+            split_key(), _shape_of(shape, self._probs),
+            minval=1e-6, maxval=1 - 1e-6,
+        )
+        p = self.probs
+        mid = jnp.abs(p - 0.5) < 1e-4
+        safe = jnp.where(mid, 0.25, p)
+        icdf = jnp.log1p(u * (2 * safe - 1) / (1 - safe)) / (
+            jnp.log(safe) - jnp.log1p(-safe)
+        )
+        return _wrap(jnp.where(mid, u, icdf))
+
+    def log_prob(self, value):
+        return _traced(
+            "cb_log_prob",
+            lambda p, v: v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+            + self._log_C(p),
+            self._probs, value,
+        )
+
+
+class MultivariateNormal(Distribution):
+    """ref: distribution/multivariate_normal.py (full covariance via
+    cholesky; TPU-friendly: one triangular solve per log_prob)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self._loc = loc
+        self.loc = _arr(loc)
+        if (covariance_matrix is None) == (scale_tril is None):
+            raise ValueError(
+                "provide exactly one of covariance_matrix / scale_tril"
+            )
+        # keep the user's Tensor so grads flow to it (autograd contract);
+        # the cholesky (when given a covariance) happens inside _traced
+        self._from_cov = scale_tril is None
+        self._scale_in = (
+            covariance_matrix if self._from_cov else scale_tril
+        )
+        self.scale_tril = (
+            jnp.linalg.cholesky(_arr(covariance_matrix))
+            if self._from_cov else _arr(scale_tril)
+        )
+        super().__init__(jnp.shape(self.loc)[:-1])
+        self._event = jnp.shape(self.loc)[-1]
+
+    def _tril(self, raw):
+        return jnp.linalg.cholesky(raw) if self._from_cov else raw
+
+    @property
+    def mean(self):
+        return _wrap(self.loc)
+
+    @property
+    def variance(self):
+        return _wrap(jnp.sum(jnp.square(self.scale_tril), -1))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(
+            split_key(), tuple(shape) + jnp.shape(self.loc)
+        )
+
+        def fn(loc, raw):
+            L = self._tril(raw)
+            return loc + jnp.einsum("...ij,...j->...i", L, eps)
+
+        return _traced("mvn_rsample", fn, self._loc, self._scale_in)
+
+    def log_prob(self, value):
+        def fn(loc, raw, v):
+            L = self._tril(raw)
+            diff = v - loc
+            # solve_triangular does not broadcast batch dims: align L
+            # with the sample batch explicitly
+            bshape = jnp.broadcast_shapes(L.shape[:-2], diff.shape[:-1])
+            Lb = jnp.broadcast_to(L, bshape + L.shape[-2:])
+            db = jnp.broadcast_to(diff, bshape + diff.shape[-1:])
+            sol = jax.scipy.linalg.solve_triangular(
+                Lb, db[..., None], lower=True
+            )[..., 0]
+            logdet = jnp.sum(
+                jnp.log(jnp.diagonal(Lb, axis1=-2, axis2=-1)), -1
+            )
+            k = diff.shape[-1]
+            return (
+                -0.5 * jnp.sum(jnp.square(sol), -1)
+                - logdet - 0.5 * k * math.log(2 * math.pi)
+            )
+
+        return _traced(
+            "mvn_log_prob", fn, self._loc, self._scale_in, value
+        )
+
+    def entropy(self):
+        def fn(_loc, raw):
+            L = self._tril(raw)
+            logdet = jnp.sum(
+                jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1
+            )
+            k = self._event
+            return 0.5 * k * (1 + math.log(2 * math.pi)) + logdet
+
+        return _traced("mvn_entropy", fn, self._loc, self._scale_in)
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims as event dims
+    (ref: distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bshape = tuple(base.batch_shape)
+        super().__init__(bshape[: len(bshape) - self.rank])
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        from .. import ops as F
+
+        return F.sum(lp, list(range(lp.ndim - self.rank, lp.ndim)))
+
+    def entropy(self):
+        ent = self.base.entropy()
+        from .. import ops as F
+
+        return F.sum(ent, list(range(ent.ndim - self.rank, ent.ndim)))
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a chain of transforms
+    (ref: distribution/transformed_distribution.py)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if not isinstance(transforms, (list, tuple)):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        super().__init__(tuple(base.batch_shape))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape) if hasattr(
+            self.base, "rsample"
+        ) else self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        from .. import ops as F
+
+        if not isinstance(value, Tensor):
+            value = F.to_tensor(value)
+        lp = None
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ld = t.forward_log_det_jacobian(x)
+            lp = ld if lp is None else lp + ld
+            y = x
+        base_lp = self.base.log_prob(y)
+        return base_lp - (lp if lp is not None else 0.0)

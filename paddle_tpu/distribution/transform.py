@@ -1,0 +1,383 @@
+"""Distribution transforms (ref: python/paddle/distribution/transform.py —
+Transform base + Abs/Affine/Chain/Exp/Independent/Power/Reshape/Sigmoid/
+Softmax/Stack/StickBreaking/Tanh). Compact TPU-first rewrite: every
+forward/inverse/log-det is expressed in framework ops so it rides the
+autograd tape and stages under jit; domain/codomain bookkeeping reduces
+to the event_rank ints the log_prob algebra actually needs."""
+from __future__ import annotations
+
+import math
+
+from .. import ops as F
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "Transform",
+    "AbsTransform",
+    "AffineTransform",
+    "ChainTransform",
+    "ExpTransform",
+    "IndependentTransform",
+    "PowerTransform",
+    "ReshapeTransform",
+    "SigmoidTransform",
+    "SoftmaxTransform",
+    "StackTransform",
+    "StickBreakingTransform",
+    "TanhTransform",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+class Transform:
+    """Bijection y = f(x) with log|det J| bookkeeping.
+
+    Subclasses implement _forward/_inverse and one of the two log-det
+    directions; event ranks describe how many trailing dims one event
+    spans on each side (ref transform.py:71 Transform)."""
+
+    _domain_event_rank = 0
+    _codomain_event_rank = 0
+    bijective = True
+
+    def forward(self, x):
+        return self._forward(_t(x))
+
+    def inverse(self, y):
+        return self._inverse(_t(y))
+
+    def forward_log_det_jacobian(self, x):
+        x = _t(x)
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return self._forward_log_det_jacobian(x)
+        return -self._inverse_log_det_jacobian(self._forward(x))
+
+    def inverse_log_det_jacobian(self, y):
+        y = _t(y)
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return self._inverse_log_det_jacobian(y)
+        return -self._forward_log_det_jacobian(self._inverse(y))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AbsTransform(Transform):
+    """y = |x| (non-bijective; inverse returns the positive branch,
+    ref transform.py:372)."""
+
+    bijective = False
+
+    def _forward(self, x):
+        return F.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (ref transform.py:445)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return F.log(F.abs(F.broadcast_to(self.scale, x.shape)))
+
+
+class ExpTransform(Transform):
+    """y = exp(x) (ref transform.py:657)."""
+
+    def _forward(self, x):
+        return F.exp(x)
+
+    def _inverse(self, y):
+        return F.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power on the positive line (ref transform.py)."""
+
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def _forward(self, x):
+        return F.pow(x, self.power)
+
+    def _inverse(self, y):
+        return F.pow(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return F.log(F.abs(self.power * F.pow(x, self.power - 1.0)))
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) (ref transform.py)."""
+
+    def _forward(self, x):
+        return F.sigmoid(x)
+
+    def _inverse(self, y):
+        return F.log(y) - F.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log sigma'(x) = -softplus(-x) - softplus(x)
+        return -F.softplus(-x) - F.softplus(x)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) (ref transform.py)."""
+
+    def _forward(self, x):
+        return F.tanh(x)
+
+    def _inverse(self, y):
+        return F.atanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2(log 2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - F.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last dim (non-bijective onto the simplex;
+    inverse is log up to an additive constant, ref transform.py)."""
+
+    bijective = False
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        return F.softmax(x, -1)
+
+    def _inverse(self, y):
+        return F.log(y)
+
+
+class StickBreakingTransform(Transform):
+    """R^{n} -> interior of the n-simplex via stick breaking
+    (ref transform.py StickBreakingTransform)."""
+
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        n = x.shape[-1]
+        offset = to_tensor(
+            [float(n - i) for i in range(n)]
+        ).astype(x.dtype)
+        z = F.sigmoid(x - F.log(offset))
+        return _stick_break(z, x)
+
+    def _inverse(self, y):
+        n = y.shape[-1] - 1
+        cum = F.cumsum(y, -1)
+        lower = F.concat(
+            [F.zeros(list(y.shape[:-1]) + [1], y.dtype), cum[..., :-1]], -1
+        )[..., :n]
+        z = y[..., :n] / (1.0 - lower)
+        offset = to_tensor(
+            [float(n - i) for i in range(n)]
+        ).astype(y.dtype)
+        return F.log(z) - F.log1p(-z) + F.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        n = x.shape[-1]
+        offset = to_tensor(
+            [float(n - i) for i in range(n)]
+        ).astype(x.dtype)
+        xo = x - F.log(offset)
+        z = F.sigmoid(xo)
+        onem = F.concat(
+            [F.ones(list(x.shape[:-1]) + [1], x.dtype), 1.0 - z], -1
+        )
+        rema = F.cumprod(onem, -1)[..., :-1]  # remaining stick before i
+        # dy_i/dz_i = remaining_i; dz/dx = sigma'(xo)
+        return F.sum(
+            F.log(rema) - F.softplus(-xo) - F.softplus(xo), -1
+        )
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+def _stick_break(z, x):
+    """stick-breaking assembly: y_i = z_i * prod_{j<i}(1-z_j), last
+    entry takes the remainder."""
+    onem = F.concat(
+        [F.ones(list(x.shape[:-1]) + [1], x.dtype), 1.0 - z], -1
+    )
+    rema = F.cumprod(onem, -1)  # [..., n+1]; rema[-1] = leftover
+    zpad = F.concat(
+        [z, F.ones(list(x.shape[:-1]) + [1], x.dtype)], -1
+    )
+    return zpad * rema
+
+
+class ReshapeTransform(Transform):
+    """Reshape trailing event dims (ref transform.py ReshapeTransform)."""
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(int(s) for s in in_event_shape)
+        self.out_event_shape = tuple(int(s) for s in out_event_shape)
+        import numpy as np
+
+        if int(np.prod(self.in_event_shape)) != int(
+            np.prod(self.out_event_shape)
+        ):
+            raise ValueError(
+                f"in_event_shape {in_event_shape} and out_event_shape "
+                f"{out_event_shape} have different sizes"
+            )
+        self._domain_event_rank = len(self.in_event_shape)
+        self._codomain_event_rank = len(self.out_event_shape)
+
+    def _batch(self, x, rank):
+        return list(x.shape[: x.ndim - rank])
+
+    def _forward(self, x):
+        return F.reshape(
+            x, self._batch(x, len(self.in_event_shape))
+            + list(self.out_event_shape)
+        )
+
+    def _inverse(self, y):
+        return F.reshape(
+            y, self._batch(y, len(self.out_event_shape))
+            + list(self.in_event_shape)
+        )
+
+    def _forward_log_det_jacobian(self, x):
+        return F.zeros(self._batch(x, len(self.in_event_shape)), x.dtype)
+
+    def forward_shape(self, shape):
+        r = len(self.in_event_shape)
+        return tuple(shape[:len(shape) - r]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        r = len(self.out_event_shape)
+        return tuple(shape[:len(shape) - r]) + self.in_event_shape
+
+
+class IndependentTransform(Transform):
+    """Reinterpret batch dims of a base transform as event dims, summing
+    that many trailing dims out of the log-det (ref transform.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self._domain_event_rank = base._domain_event_rank + self.rank
+        self._codomain_event_rank = base._codomain_event_rank + self.rank
+
+    def _forward(self, x):
+        return self.base.forward(x)
+
+    def _inverse(self, y):
+        return self.base.inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ld = self.base.forward_log_det_jacobian(x)
+        return F.sum(ld, list(range(ld.ndim - self.rank, ld.ndim)))
+
+    def forward_shape(self, shape):
+        return self.base.forward_shape(shape)
+
+    def inverse_shape(self, shape):
+        return self.base.inverse_shape(shape)
+
+
+class ChainTransform(Transform):
+    """Composition t_n ∘ ... ∘ t_1 (ref transform.py:532)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self.bijective = all(t.bijective for t in self.transforms)
+        self._domain_event_rank = max(
+            (t._domain_event_rank for t in self.transforms), default=0
+        )
+        self._codomain_event_rank = max(
+            (t._codomain_event_rank for t in self.transforms), default=0
+        )
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        ld = None
+        event_rank = self._domain_event_rank
+        for t in self.transforms:
+            part = t.forward_log_det_jacobian(x)
+            reduce = event_rank - t._domain_event_rank
+            if reduce > 0:
+                part = F.sum(
+                    part, list(range(part.ndim - reduce, part.ndim))
+                )
+            ld = part if ld is None else ld + part
+            event_rank += t._codomain_event_rank - t._domain_event_rank
+            x = t.forward(x)
+        return ld
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms to slices along `axis`
+    (ref transform.py StackTransform)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, x, method):
+        parts = F.unbind(x, self.axis)
+        outs = [
+            getattr(t, method)(p)
+            for t, p in zip(self.transforms, parts)
+        ]
+        return F.stack(outs, self.axis)
+
+    def _forward(self, x):
+        return self._map(x, "forward")
+
+    def _inverse(self, y):
+        return self._map(y, "inverse")
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map(x, "forward_log_det_jacobian")

@@ -72,6 +72,65 @@ def _to_arrays(tree):
     )
 
 
+class _nan_net:
+    """Staged NaN/Inf debug net (FLAGS_check_nan_inf inside jit).
+
+    While tracing, collects each dispatched op's isfinite-violated flag
+    (core.dispatch routes them here instead of a host callback — pure
+    dataflow, so it works on backends without callback support). The
+    flags become ONE stacked bool output of the staged program; `raise_if`
+    checks it on the host after execution and names the first bad op —
+    the staged analogue of the reference's static-executor check
+    (fluid/framework/new_executor/nan_inf_utils.cc)."""
+
+    def __init__(self, enabled):
+        self.enabled = enabled
+        self.names = []
+        self._collector = [] if enabled else None
+
+    def __enter__(self):
+        if self.enabled:
+            from ..core import dispatch
+
+            self._prev = dispatch.set_nan_collector(self._collector)
+        return self
+
+    def __exit__(self, *exc):
+        if self.enabled:
+            from ..core import dispatch
+
+            dispatch.set_nan_collector(self._prev)
+        return False
+
+    def flags_output(self):
+        if not self.enabled or not self._collector:
+            return jnp.zeros((0,), jnp.bool_)
+        self.names = [n for n, _ in self._collector]
+        return jnp.stack([b for _, b in self._collector])
+
+    def raise_if(self, flags_value):
+        if not self.enabled or flags_value is None:
+            return
+        import numpy as np
+
+        vals = np.asarray(flags_value)
+        if vals.size and vals.any():
+            from ..core import flags as flags_mod
+            from ..core.dispatch import _nan_inf_report
+
+            idx = int(np.argmax(vals))
+            _nan_inf_report(
+                True, self.names[idx],
+                flags_mod.get_flag("FLAGS_check_nan_inf_level"),
+            )
+
+
+def _nan_check_enabled():
+    from ..core import flags as flags_mod
+
+    return bool(flags_mod.get_flag("FLAGS_check_nan_inf"))
+
+
 class StaticFunction:
     """Stage a tensor function or Layer forward into one XLA computation
     (ref: jit/dy2static/program_translator.py:397 StaticFunction).
@@ -101,11 +160,14 @@ class StaticFunction:
             self._buffers = []
         self._core = None
         self._out_tree = None
+        self._nan_nets = {}
+        self._cur_nan_key = None
 
     def _build_core(self):
         fn = self._function
         params, buffers = self._params, self._buffers
         outer = self
+        self._built_nan = _nan_check_enabled()
 
         def core(param_arrays, buffer_arrays, key, in_flat, in_meta):
             """in_flat: flat tensor-slot arrays; in_meta: (treedef, flat
@@ -117,9 +179,10 @@ class StaticFunction:
             args, kwargs = jax.tree_util.tree_unflatten(treedef, flat)
             old_p = _swap_payloads(params, param_arrays)
             old_b = _swap_payloads(buffers, buffer_arrays)
+            net = _nan_net(outer._built_nan)
             try:
                 with _rng_lift(key) as lift:
-                    with autograd.no_grad():
+                    with net, autograd.no_grad():
                         out = fn(*args, **kwargs)
                     new_key = lift.final_key()
                 out_flat, out_tree = jax.tree_util.tree_flatten(
@@ -130,10 +193,14 @@ class StaticFunction:
                     o._data if isinstance(o, Tensor) else o for o in out_flat
                 ]
                 new_buf = [b._data for b in buffers]
+                nan_flags = net.flags_output()
+                # one net per trace: jax.jit caches per shape signature,
+                # so flag indices must decode with THAT trace's op list
+                outer._nan_nets[outer._cur_nan_key] = net
             finally:
                 _swap_payloads(params, old_p)
                 _swap_payloads(buffers, old_b)
-            return out_arrays, new_buf, new_key
+            return out_arrays, new_buf, new_key, nan_flags
 
         return jax.jit(core, static_argnames=("in_meta",))
 
@@ -160,9 +227,20 @@ class StaticFunction:
         return arrays, (treedef, template, slots)
 
     def __call__(self, *args, **kwargs):
+        if self._core is not None and (
+            getattr(self, "_built_nan", False) != _nan_check_enabled()
+        ):
+            self._core = None  # debug-net toggle changes the program
         if self._core is None:
             self._core = self._build_core()
         in_arrays, in_meta = self._split_inputs(args, kwargs)
+        self._cur_nan_key = (
+            in_meta,
+            tuple(
+                (tuple(a.shape), str(a.dtype))
+                for a in in_arrays if hasattr(a, "shape")
+            ),
+        )
         buf_arrays = [b._data for b in self._buffers]
         key = random_mod.default_generator.split_key()
         params = self._params
@@ -176,12 +254,12 @@ class StaticFunction:
             n_p = len(params)
 
             def impl(*arrays):
-                outs, new_buf, _ = core(
+                outs, new_buf, _, nflags = core(
                     list(arrays[:n_p]), buf_arrays, key,
                     list(arrays[n_p:]), in_meta,
                 )
                 n_out[0] = len(outs)
-                return tuple(outs) + tuple(new_buf)
+                return tuple(outs) + tuple(new_buf) + (nflags,)
 
             from ..core import dispatch
 
@@ -202,15 +280,20 @@ class StaticFunction:
             )
             k = n_out[0]
             out_flat = results[:k]
-            new_buf = results[k:]
+            new_buf = results[k:-1]
+            nflags = results[-1]
+            if self._built_nan and nflags is not None:
+                self._nan_nets[self._cur_nan_key].raise_if(nflags._data)
             for b, nb in zip(self._buffers, new_buf):
                 if nb is not None:
                     b._rebind(nb.detach()._data)
             return jax.tree_util.tree_unflatten(self._out_tree, out_flat)
 
-        outs, new_buf, _ = self._core(
+        outs, new_buf, _, nflags = self._core(
             [p._data for p in params], buf_arrays, key, in_arrays, in_meta
         )
+        if self._built_nan:
+            self._nan_nets[self._cur_nan_key].raise_if(nflags)
         for b, a in zip(self._buffers, new_buf):
             b._rebind(a)
         out_flat = [
@@ -272,11 +355,15 @@ class TrainStep:
         self._buffers = [b for _, b in model.named_buffers()]
         self._compiled = None
         self._live_idx = None  # params that actually received grads
+        self._nan_nets = {}
+        self._cur_nan_key = None
 
     def _build(self):
         model, loss_fn, opt = self._model, self._loss_fn, self._opt
         params, buffers = self._params, self._buffers
         opt_step_fn = opt._make_step_fn()
+        self._built_nan = _nan_check_enabled()
+        outer = self
 
         def staged(param_arrays, buffer_arrays, states, lr, t, found_inf,
                    key, tree_args):
@@ -284,6 +371,7 @@ class TrainStep:
             old_b = _swap_payloads(buffers, buffer_arrays)
             saved = [(p.grad, p._grad_node, p._out_index, p.stop_gradient)
                      for p in params]
+            net = _nan_net(outer._built_nan)
             try:
                 for p in params:
                     p.grad = None
@@ -291,8 +379,9 @@ class TrainStep:
                     p.stop_gradient = False
                 with _rng_lift(key) as lift:
                     args, kwargs = tree_args
-                    loss = loss_fn(model, *args, **kwargs)
-                    loss.backward()
+                    with net:
+                        loss = loss_fn(model, *args, **kwargs)
+                        loss.backward()
                     new_key = lift.final_key()
 
                 live_idx = [
@@ -329,6 +418,8 @@ class TrainStep:
                     out_states[i] = new_states[j]
                 new_buffer_arrays = [b._data for b in buffers]
                 loss_val = loss._data
+                nan_flags = net.flags_output()
+                outer._nan_nets[outer._cur_nan_key] = net
             finally:
                 _swap_payloads(params, [s for s in old_p])
                 _swap_payloads(buffers, old_b)
@@ -338,7 +429,7 @@ class TrainStep:
                     p._out_index = oi
                     p.stop_gradient = sg
             return (new_param_arrays, new_buffer_arrays, out_states,
-                    loss_val, new_key)
+                    loss_val, new_key, nan_flags)
 
         donate = (0, 2) if self._donate else ()
         return jax.jit(staged, donate_argnums=donate)
@@ -373,6 +464,10 @@ class TrainStep:
 
     def __call__(self, *args, **kwargs):
         opt = self._opt
+        if self._compiled is not None and (
+            getattr(self, "_built_nan", False) != _nan_check_enabled()
+        ):
+            self._compiled = None  # debug-net toggle changes the program
         if self._compiled is None:
             self._compiled = self._build()
         states = [opt._ensure_state(p) for p in self._params]
@@ -395,11 +490,19 @@ class TrainStep:
         )
         key = random_mod.default_generator.split_key()
         tree_args = (_to_arrays(args), _to_arrays(kwargs))
-        (new_params, new_buffers, new_states, loss_val, _) = self._compiled(
+        self._cur_nan_key = tuple(
+            (tuple(a.shape), str(a.dtype))
+            for a in jax.tree_util.tree_leaves(tree_args)
+            if hasattr(a, "shape")
+        )
+        (new_params, new_buffers, new_states, loss_val, _,
+         nan_flags) = self._compiled(
             [p._data for p in self._params],
             [b._data for b in self._buffers],
             states, lr, t, found_inf, key, tree_args,
         )
+        if self._built_nan:
+            self._nan_nets[self._cur_nan_key].raise_if(nan_flags)
         with autograd.no_grad():
             for p, a, ns in zip(self._params, new_params, new_states):
                 p._rebind(a)

@@ -37,8 +37,6 @@ def moe_gate_dispatch(x, gate_logits, *, k=2, capacity=0,
     e = gate_logits.shape[-1]
     gates = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
     vals, idx = jax.lax.top_k(gates, k)               # [s, k]
-    if renormalize:
-        vals = vals / (vals.sum(-1, keepdims=True) + 1e-9)
     if capacity:
         c = int(capacity)
     else:
@@ -46,7 +44,13 @@ def moe_gate_dispatch(x, gate_logits, *, k=2, capacity=0,
         c = max(8, -(-c // 8) * 8)
 
     flat_e = idx.reshape(-1).astype(jnp.int32)        # [s*k]
-    order = jnp.argsort(flat_e, stable=True)          # expert-sorted
+    # capacity priority matches the reference's k-pass gate (and the
+    # dense GShard formulation): within an expert, ALL first-choice
+    # assignments outrank second choices, ties by token order — sort by
+    # the composite (expert, choice_rank, token) key
+    ar = jnp.arange(s * k, dtype=jnp.int32)
+    composite = flat_e * (s * k) + (ar % k) * s + ar // k
+    order = jnp.argsort(composite)
     sorted_e = flat_e[order]
     seg_start = jnp.searchsorted(
         sorted_e, jnp.arange(e, dtype=sorted_e.dtype), side="left"
@@ -67,6 +71,14 @@ def moe_gate_dispatch(x, gate_logits, *, k=2, capacity=0,
     slots = (
         jnp.full((s * k,), -1, jnp.int32).at[order].set(slot_sorted)
     ).reshape(s, k)
+
+    # renormalize over the KEPT assignments (the dense GShard contract:
+    # a token whose secondary expert overflowed pushes its full weight
+    # onto the surviving expert), matching TopKGate's post-capacity
+    # combine renormalization
+    if renormalize:
+        kept_w = vals * (slots >= 0).astype(vals.dtype)
+        vals = kept_w / (kept_w.sum(-1, keepdims=True) + 1e-9)
 
     # GShard load-balancing aux: e * sum(mean_gate * assigned_fraction)
     me = gates.mean(0)                                # [e]

@@ -1,0 +1,365 @@
+"""fft op family + new distributions + transforms.
+
+Mirrors the reference's test/legacy_test/test_fft.py (numpy.fft oracle)
+and test/distribution/* (scipy oracle).
+"""
+import numpy as np
+import pytest
+import scipy.stats
+
+import paddle_tpu as paddle
+import paddle_tpu.ops as F
+from op_test import check_grad, check_output
+
+
+class TestFFT:
+    def _x(self, shape=(4, 16), seed=0):
+        return np.random.default_rng(seed).standard_normal(shape).astype(
+            "float32"
+        )
+
+    @pytest.mark.parametrize("name", [
+        "fft", "ifft", "rfft", "ihfft",
+    ])
+    def test_1d_matches_numpy(self, name):
+        x = self._x()
+        check_output(
+            getattr(F, name),
+            lambda x, _n=name: getattr(np.fft, _n)(x),
+            {"x": x}, rtol=1e-4, atol=1e-4,
+        )
+
+    @pytest.mark.parametrize("name", ["fft2", "ifft2", "rfft2"])
+    def test_2d_matches_numpy(self, name):
+        x = self._x()
+        check_output(
+            getattr(F, name),
+            lambda x, _n=name: getattr(np.fft, _n)(x),
+            {"x": x}, rtol=1e-4, atol=1e-4,
+        )
+
+    @pytest.mark.parametrize("name", ["fftn", "ifftn", "rfftn"])
+    def test_nd_matches_numpy(self, name):
+        x = self._x((2, 4, 8))
+        check_output(
+            getattr(F, name),
+            lambda x, _n=name: getattr(np.fft, _n)(x),
+            {"x": x}, rtol=1e-4, atol=1e-4,
+        )
+
+    def test_n_and_norm(self):
+        x = self._x((8,))
+        got = F.fft(paddle.to_tensor(x), n=16, norm="ortho").numpy()
+        want = np.fft.fft(x, n=16, norm="ortho")
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        with pytest.raises(ValueError):
+            F.fft(paddle.to_tensor(x), norm="bogus")
+
+    def test_roundtrips(self):
+        x = self._x()
+        rt = F.irfft(F.rfft(paddle.to_tensor(x)), n=16).numpy()
+        np.testing.assert_allclose(rt, x, rtol=1e-4, atol=1e-5)
+        rt2 = F.ifft(F.fft(paddle.to_tensor(x))).numpy()
+        np.testing.assert_allclose(rt2.real, x, rtol=1e-4, atol=1e-5)
+        h = F.hfft(F.ihfft(paddle.to_tensor(x)), n=16).numpy()
+        np.testing.assert_allclose(h, x, rtol=1e-3, atol=1e-4)
+
+    def test_shift_freq(self):
+        x = self._x((9,))
+        np.testing.assert_allclose(
+            F.fftshift(paddle.to_tensor(x)).numpy(), np.fft.fftshift(x)
+        )
+        np.testing.assert_allclose(
+            F.ifftshift(paddle.to_tensor(x)).numpy(), np.fft.ifftshift(x)
+        )
+        np.testing.assert_allclose(
+            paddle.fft.fftfreq(8, 0.5).numpy(), np.fft.fftfreq(8, 0.5),
+            rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            paddle.fft.rfftfreq(8).numpy(), np.fft.rfftfreq(8), rtol=1e-6
+        )
+
+    def test_gradients_through_real_composite(self):
+        # real -> rfft -> irfft -> real keeps check_grad applicable
+        check_grad(
+            lambda x: F.irfft(F.rfft(x), n=16),
+            {"x": self._x((16,))}, rtol=2e-2,
+        )
+
+    def test_power_spectrum_gradient(self):
+        def power(x):
+            c = F.rfft(x)
+            return F.sum(F.real(c * F.conj(c)))
+
+        x = paddle.to_tensor(self._x((16,)))
+        x.stop_gradient = False
+        power(x).backward()
+        # Parseval: d/dx sum|X_k|^2 = 2*N*x  (rfft one-sided needs care;
+        # just check the gradient is finite and nonzero)
+        g = x.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+class TestNewDistributions:
+    def test_poisson(self):
+        d = paddle.distribution.Poisson(paddle.to_tensor(3.0))
+        s = d.sample([500])
+        assert abs(float(s.numpy().mean()) - 3.0) < 0.5
+        lp = d.log_prob(paddle.to_tensor(2.0))
+        np.testing.assert_allclose(
+            float(lp.numpy()), scipy.stats.poisson.logpmf(2, 3.0),
+            rtol=1e-5,
+        )
+
+    def test_geometric(self):
+        d = paddle.distribution.Geometric(paddle.to_tensor(0.3))
+        lp = d.log_prob(paddle.to_tensor(4.0))
+        np.testing.assert_allclose(
+            float(lp.numpy()), scipy.stats.geom.logpmf(5, 0.3), rtol=1e-5
+        )  # scipy geom counts trials, ours counts failures
+        np.testing.assert_allclose(
+            float(d.mean.numpy()), 0.7 / 0.3, rtol=1e-6
+        )
+
+    def test_binomial(self):
+        d = paddle.distribution.Binomial(
+            paddle.to_tensor(10.0), paddle.to_tensor(0.4)
+        )
+        lp = d.log_prob(paddle.to_tensor(3.0))
+        np.testing.assert_allclose(
+            float(lp.numpy()), scipy.stats.binom.logpmf(3, 10, 0.4),
+            rtol=1e-5,
+        )
+        s = d.sample([400])
+        assert abs(float(s.numpy().mean()) - 4.0) < 0.5
+
+    def test_cauchy(self):
+        d = paddle.distribution.Cauchy(
+            paddle.to_tensor(1.0), paddle.to_tensor(2.0)
+        )
+        lp = d.log_prob(paddle.to_tensor(0.5))
+        np.testing.assert_allclose(
+            float(lp.numpy()),
+            scipy.stats.cauchy.logpdf(0.5, 1.0, 2.0), rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            float(d.entropy().numpy()),
+            scipy.stats.cauchy.entropy(1.0, 2.0), rtol=1e-5,
+        )
+
+    def test_chi2(self):
+        d = paddle.distribution.Chi2(paddle.to_tensor(3.0))
+        lp = d.log_prob(paddle.to_tensor(2.5))
+        np.testing.assert_allclose(
+            float(lp.numpy()), scipy.stats.chi2.logpdf(2.5, 3), rtol=1e-5
+        )
+
+    def test_student_t(self):
+        d = paddle.distribution.StudentT(
+            paddle.to_tensor(5.0), paddle.to_tensor(1.0),
+            paddle.to_tensor(2.0),
+        )
+        lp = d.log_prob(paddle.to_tensor(0.0))
+        np.testing.assert_allclose(
+            float(lp.numpy()),
+            scipy.stats.t.logpdf(0.0, 5, loc=1.0, scale=2.0), rtol=1e-5,
+        )
+
+    def test_continuous_bernoulli(self):
+        d = paddle.distribution.ContinuousBernoulli(paddle.to_tensor(0.3))
+        # density integrates to ~1
+        xs = np.linspace(1e-4, 1 - 1e-4, 2001).astype("float32")
+        lp = d.log_prob(paddle.to_tensor(xs)).numpy()
+        integral = np.trapezoid(np.exp(lp), xs)
+        np.testing.assert_allclose(integral, 1.0, rtol=1e-3)
+        # taylor branch near p=1/2 stays finite
+        dmid = paddle.distribution.ContinuousBernoulli(
+            paddle.to_tensor(0.5)
+        )
+        assert np.isfinite(dmid.log_prob(paddle.to_tensor(0.7)).numpy())
+
+    def test_multivariate_normal(self):
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        loc = np.array([1.0, -1.0], np.float32)
+        d = paddle.distribution.MultivariateNormal(
+            paddle.to_tensor(loc), covariance_matrix=paddle.to_tensor(cov)
+        )
+        v = np.array([0.5, 0.0], np.float32)
+        lp = d.log_prob(paddle.to_tensor(v))
+        np.testing.assert_allclose(
+            float(lp.numpy()),
+            scipy.stats.multivariate_normal.logpdf(v, loc, cov),
+            rtol=1e-4,
+        )
+        s = d.rsample([2000])
+        emp = np.cov(s.numpy().T)
+        np.testing.assert_allclose(emp, cov, atol=0.3)
+        np.testing.assert_allclose(
+            float(d.entropy().numpy()),
+            scipy.stats.multivariate_normal.entropy(loc, cov), rtol=1e-4,
+        )
+
+    def test_poisson_small_rate_entropy(self):
+        for rate in (0.1, 1.0, 5.0, 40.0):
+            d = paddle.distribution.Poisson(paddle.to_tensor(float(rate)))
+            np.testing.assert_allclose(
+                float(d.entropy().numpy()),
+                scipy.stats.poisson(rate).entropy(), rtol=2e-3,
+                err_msg=f"rate={rate}",
+            )
+
+    def test_mvn_batched_log_prob_and_cov_grads(self):
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        loc = np.array([1.0, -1.0], np.float32)
+        covt = paddle.to_tensor(cov)
+        covt.stop_gradient = False
+        d = paddle.distribution.MultivariateNormal(
+            paddle.to_tensor(loc), covariance_matrix=covt
+        )
+        vs = np.random.default_rng(0).standard_normal((5, 2)).astype(
+            "float32"
+        )
+        lp = d.log_prob(paddle.to_tensor(vs))
+        assert lp.shape == [5]
+        want = scipy.stats.multivariate_normal.logpdf(vs, loc, cov)
+        np.testing.assert_allclose(lp.numpy(), want, rtol=1e-4)
+        lp.sum().backward()
+        assert covt.grad is not None
+        assert np.abs(covt.grad.numpy()).max() > 0
+
+    def test_independent(self):
+        base = paddle.distribution.Normal(
+            paddle.to_tensor(np.zeros((3, 4), np.float32)),
+            paddle.to_tensor(np.ones((3, 4), np.float32)),
+        )
+        d = paddle.distribution.Independent(base, 1)
+        lp = d.log_prob(paddle.to_tensor(np.zeros((3, 4), np.float32)))
+        assert lp.shape == [3]
+        np.testing.assert_allclose(
+            lp.numpy(), base.log_prob(
+                paddle.to_tensor(np.zeros((3, 4), np.float32))
+            ).numpy().sum(-1),
+            rtol=1e-6,
+        )
+
+
+class TestTransforms:
+    @pytest.mark.parametrize("t,x", [
+        ("ExpTransform", np.array([0.3, -1.2], np.float32)),
+        ("SigmoidTransform", np.array([0.5, -0.7], np.float32)),
+        ("TanhTransform", np.array([0.2, -0.4], np.float32)),
+    ])
+    def test_roundtrip_and_logdet(self, t, x):
+        import jax
+
+        T = getattr(paddle.distribution.transform, t)()
+        xt = paddle.to_tensor(x)
+        y = T.forward(xt)
+        back = T.inverse(y)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-5, atol=1e-6)
+        # log-det vs autodiff d f / d x (elementwise transforms)
+        import jax.numpy as jnp
+
+        fwd = {
+            "ExpTransform": jnp.exp,
+            "SigmoidTransform": jax.nn.sigmoid,
+            "TanhTransform": jnp.tanh,
+        }[t]
+        want = np.log(np.abs(np.asarray(
+            jax.vmap(jax.grad(fwd))(jnp.asarray(x))
+        )))
+        np.testing.assert_allclose(
+            T.forward_log_det_jacobian(xt).numpy(), want,
+            rtol=1e-4, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            T.inverse_log_det_jacobian(y).numpy(), -want,
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_affine_and_chain(self):
+        tr = paddle.distribution.transform
+        chain = tr.ChainTransform([
+            tr.AffineTransform(paddle.to_tensor(1.0),
+                               paddle.to_tensor(2.0)),
+            tr.ExpTransform(),
+        ])
+        x = paddle.to_tensor(np.array([0.1, -0.3], np.float32))
+        y = chain.forward(x)
+        np.testing.assert_allclose(
+            y.numpy(), np.exp(1.0 + 2.0 * x.numpy()), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            chain.inverse(y).numpy(), x.numpy(), rtol=1e-5
+        )
+        # logdet: log 2 + (1 + 2x)
+        np.testing.assert_allclose(
+            chain.forward_log_det_jacobian(x).numpy(),
+            np.log(2.0) + 1.0 + 2.0 * x.numpy(), rtol=1e-5,
+        )
+
+    def test_stick_breaking(self):
+        tr = paddle.distribution.transform.StickBreakingTransform()
+        x = paddle.to_tensor(np.array([0.4, -0.2, 0.8], np.float32))
+        y = tr.forward(x)
+        assert y.shape == [4]
+        np.testing.assert_allclose(float(y.numpy().sum()), 1.0, rtol=1e-5)
+        assert (y.numpy() > 0).all()
+        np.testing.assert_allclose(
+            tr.inverse(y).numpy(), x.numpy(), rtol=1e-4, atol=1e-5
+        )
+        assert tr.forward_shape((3,)) == (4,)
+
+    def test_reshape_stack_independent(self):
+        tr = paddle.distribution.transform
+        r = tr.ReshapeTransform((2, 3), (6,))
+        x = paddle.to_tensor(
+            np.arange(6, dtype=np.float32).reshape(2, 3)
+        )
+        assert r.forward(x).shape == [6]
+        np.testing.assert_allclose(
+            r.inverse(r.forward(x)).numpy(), x.numpy()
+        )
+        st = tr.StackTransform(
+            [tr.ExpTransform(), tr.TanhTransform()], axis=0
+        )
+        x2 = paddle.to_tensor(np.array([[0.1, 0.2], [0.3, 0.4]], np.float32))
+        y2 = st.forward(x2)
+        np.testing.assert_allclose(
+            y2.numpy()[0], np.exp([0.1, 0.2]), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            y2.numpy()[1], np.tanh([0.3, 0.4]), rtol=1e-5
+        )
+        it = tr.IndependentTransform(tr.ExpTransform(), 1)
+        ld = it.forward_log_det_jacobian(x2)
+        assert ld.shape == [2]
+
+    def test_transformed_distribution_lognormal(self):
+        """Normal + ExpTransform must agree with LogNormal."""
+        base = paddle.distribution.Normal(
+            paddle.to_tensor(0.5), paddle.to_tensor(0.8)
+        )
+        d = paddle.distribution.TransformedDistribution(
+            base, [paddle.distribution.transform.ExpTransform()]
+        )
+        ref = paddle.distribution.LogNormal(
+            paddle.to_tensor(0.5), paddle.to_tensor(0.8)
+        )
+        v = paddle.to_tensor(np.array([0.7, 2.1], np.float32))
+        np.testing.assert_allclose(
+            d.log_prob(v).numpy(), ref.log_prob(v).numpy(), rtol=1e-5
+        )
+        s = d.sample([100])
+        assert (s.numpy() > 0).all()
+
+    def test_transform_gradients_on_tape(self):
+        tr = paddle.distribution.transform
+        scale = paddle.to_tensor(2.0)
+        scale.stop_gradient = False
+        t = tr.AffineTransform(paddle.to_tensor(0.0), scale)
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        y = t.forward(x)
+        y.sum().backward()
+        np.testing.assert_allclose(float(scale.grad.numpy()), 3.0)

@@ -318,6 +318,28 @@ class TestSortBasedDispatch:
             rtol=1e-4, atol=1e-5,
         )
 
+    def test_matches_dense_dispatch_under_drops(self):
+        """Renormalization happens over KEPT assignments (the dense
+        contract): the paths must agree even when capacity drops occur."""
+        import paddle_tpu.ops as F
+
+        paddle.seed(0)
+        moe = MoELayer(d_model=16, num_experts=4, d_ff=32, k=2,
+                       capacity_factor=0.6)  # forces drops when unbalanced
+        x_np = np.random.RandomState(7).randn(1, 16, 16).astype(np.float32)
+        out_sorted, _, stats = moe(paddle.to_tensor(x_np),
+                                   return_stats=True)
+
+        flat = paddle.to_tensor(x_np.reshape(16, 16))
+        dispatch, combine, _ = moe.gate(flat)
+        dispatched = F.einsum("sec,sm->ecm", dispatch, flat)
+        expert_out = moe.experts(dispatched)
+        out_dense = F.einsum("sec,ecm->sm", combine, expert_out)
+        np.testing.assert_allclose(
+            out_sorted.numpy().reshape(16, 16), out_dense.numpy(),
+            rtol=1e-4, atol=1e-5,
+        )
+
     def test_drop_stats_and_capacity(self):
         paddle.seed(0)
         moe = MoELayer(d_model=8, num_experts=2, d_ff=16, k=1,
