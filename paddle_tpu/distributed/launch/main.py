@@ -47,6 +47,13 @@ def _parse(argv):
                    help="per-worker log directory")
     p.add_argument("--devices", type=str, default=None,
                    help="visible device ids (comma separated)")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic: relaunch the pod up to N times after a "
+                        "worker failure (workers resume from their own "
+                        "checkpoints; PADDLE_RESTART_COUNT tells them "
+                        "which incarnation they are)")
+    p.add_argument("--restart_interval", type=float, default=1.0,
+                   help="seconds between elastic relaunches")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -74,18 +81,47 @@ def _worker_env(args, local_rank):
 
 
 def launch(argv=None):
+    """Run the pod; with --max_restarts > 0, relaunch it after worker
+    failures (the elastic policy).
+
+    ref: fleet/elastic/manager.py:125 — the reference's elastic manager
+    watches etcd membership and rebuilds the pod on change. The TPU
+    single-controller form needs no external store: the pod IS the
+    membership (one process per host over the jax coordination service),
+    so elasticity reduces to supervised relaunch — each incarnation gets
+    PADDLE_RESTART_COUNT and resumes from its sharded checkpoint
+    (distributed/checkpoint.py), which is the reference's
+    train-resume contract."""
     args = _parse(argv if argv is not None else sys.argv[1:])
+    restarts = 0
+    while True:
+        code = _run_pod(args, restarts)
+        if code in (0, 130) or restarts >= args.max_restarts:
+            return code
+        restarts += 1
+        print(
+            f"elastic: relaunching pod (restart {restarts}/"
+            f"{args.max_restarts}) in {args.restart_interval}s",
+            file=sys.stderr,
+        )
+        time.sleep(args.restart_interval)
+
+
+def _run_pod(args, restart_count=0):
     os.makedirs(args.log_dir, exist_ok=True)
 
     procs = []
     for local_rank in range(args.nproc_per_node):
         rank = args.rank * args.nproc_per_node + local_rank
-        log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
+        suffix = f".r{restart_count}" if restart_count else ""
+        log_path = os.path.join(args.log_dir, f"workerlog.{rank}{suffix}")
         log_f = open(log_path, "w")
         cmd = [sys.executable, "-u", args.training_script,
                *args.training_script_args]
+        env = _worker_env(args, local_rank)
+        env["PADDLE_RESTART_COUNT"] = str(restart_count)
         proc = subprocess.Popen(
-            cmd, env=_worker_env(args, local_rank),
+            cmd, env=env,
             stdout=log_f, stderr=subprocess.STDOUT,
         )
         procs.append((proc, log_f, log_path))

@@ -1136,10 +1136,10 @@ class TransformedDistribution(Distribution):
         return x
 
     def log_prob(self, value):
-        from .. import ops as F
-
         if not isinstance(value, Tensor):
-            value = F.to_tensor(value)
+            from ..core.tensor import to_tensor
+
+            value = to_tensor(value)
         lp = None
         y = value
         for t in reversed(self.transforms):

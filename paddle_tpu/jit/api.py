@@ -490,10 +490,13 @@ class TrainStep:
         )
         key = random_mod.default_generator.split_key()
         tree_args = (_to_arrays(args), _to_arrays(kwargs))
-        self._cur_nan_key = tuple(
-            (tuple(a.shape), str(a.dtype))
-            for a in jax.tree_util.tree_leaves(tree_args)
-            if hasattr(a, "shape")
+        self._cur_nan_key = (
+            jax.tree_util.tree_structure(tree_args),
+            tuple(
+                (tuple(a.shape), str(a.dtype))
+                for a in jax.tree_util.tree_leaves(tree_args)
+                if hasattr(a, "shape")
+            ),
         )
         (new_params, new_buffers, new_states, loss_val, _,
          nan_flags) = self._compiled(
@@ -501,8 +504,6 @@ class TrainStep:
             [b._data for b in self._buffers],
             states, lr, t, found_inf, key, tree_args,
         )
-        if self._built_nan:
-            self._nan_nets[self._cur_nan_key].raise_if(nan_flags)
         with autograd.no_grad():
             for p, a, ns in zip(self._params, new_params, new_states):
                 p._rebind(a)
@@ -511,4 +512,10 @@ class TrainStep:
             for b, a in zip(self._buffers, new_buffers):
                 b._rebind(a)
         opt._global_step += 1
+        if self._built_nan:
+            # raise AFTER rebinding: the pre-step buffers were donated,
+            # so the new (NaN-carrying but valid) arrays must land on the
+            # params or a caught error leaves the model pointing at
+            # deleted buffers — resume from checkpoint to recover values
+            self._nan_nets[self._cur_nan_key].raise_if(nan_flags)
         return Tensor(loss_val, stop_gradient=True)

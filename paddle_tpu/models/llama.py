@@ -40,6 +40,7 @@ class LlamaConfig:
         num_experts_per_tok=2,
         router_aux_loss_coef=0.02,
         recompute=False,
+        fused_loss_chunk=0,
     ):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -59,6 +60,10 @@ class LlamaConfig:
         # jax.checkpoint each decoder layer (the reference's recompute
         # pass, auto_parallel_recompute.py) — bigger batches per chip
         self.recompute = recompute
+        # >0: compute the LM loss via the chunked fused head
+        # (F.fused_linear_cross_entropy) so the [b, s, vocab] fp32 logits
+        # never materialize — the HBM hog at billion-param scale
+        self.fused_loss_chunk = fused_loss_chunk
 
     @classmethod
     def tiny(cls, **overrides):
@@ -305,6 +310,20 @@ class LlamaForCausalLM(GenerationMixin, Layer):
         aux = None
         if isinstance(hidden, tuple):
             hidden, aux = hidden
+        if labels is not None and self.config.fused_loss_chunk > 0:
+            b, s, h = hidden.shape
+            head_w = (
+                self.lm_head.weight if self.lm_head is not None
+                else F.transpose(self.llama.embed_tokens.weight, [1, 0])
+            )
+            loss = F.fused_linear_cross_entropy(
+                F.reshape(hidden[:, :-1], [-1, h]), head_w,
+                F.reshape(labels[:, 1:], [-1]),
+                chunk_size=self.config.fused_loss_chunk,
+            )
+            if aux is not None:
+                loss = loss + self.config.router_aux_loss_coef * aux
+            return None, loss
         if self.lm_head is not None:
             logits = self.lm_head(hidden)
         else:

@@ -138,13 +138,12 @@ def irfftn(x, *, s=None, axes=None, norm="backward"):
     return jnp.fft.irfftn(x, s=s, axes=axes, norm=_norm(norm))
 
 
-@_host_fft
 def fftshift(x, *, axes=None):
+    # real-only roll: runs natively on TPU, no host detour needed
     axes = None if axes is None else tuple(int(a) for a in axes)
     return jnp.fft.fftshift(x, axes=axes)
 
 
-@_host_fft
 def ifftshift(x, *, axes=None):
     axes = None if axes is None else tuple(int(a) for a in axes)
     return jnp.fft.ifftshift(x, axes=axes)

@@ -144,6 +144,85 @@ class TestLauncher:
         ])
         assert code == 3
 
+    def test_elastic_restart_resumes_from_checkpoint(
+        self, tmp_path, monkeypatch
+    ):
+        """Elastic policy (ref fleet/elastic/manager.py): a worker that
+        crashes mid-training is relaunched and RESUMES from its
+        checkpoint — training completes with a continuous step count."""
+        import os as _os
+
+        import paddle_tpu as _pt
+
+        repo = _os.path.dirname(_os.path.dirname(_pt.__file__))
+        monkeypatch.setenv(
+            "PYTHONPATH",
+            repo + _os.pathsep + _os.environ.get("PYTHONPATH", ""),
+        )
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os, json\n"
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import numpy as np\n"
+            "import paddle_tpu as paddle\n"
+            f"ckpt = {str(tmp_path / 'ckpt.pdparams')!r}\n"
+            f"trace = {str(tmp_path / 'trace.jsonl')!r}\n"
+            "paddle.seed(0)\n"
+            "lin = paddle.nn.Linear(4, 4)\n"
+            "opt = paddle.optimizer.Adam(learning_rate=0.1,\n"
+            "                            parameters=lin.parameters())\n"
+            "start = 0\n"
+            "if os.path.exists(ckpt):\n"
+            "    state = paddle.load(ckpt)\n"
+            "    lin.set_state_dict(state['model'])\n"
+            "    opt.set_state_dict(state['opt'])\n"
+            "    start = state['step']\n"
+            "x = paddle.to_tensor(np.ones((2, 4), np.float32))\n"
+            "for step in range(start, 6):\n"
+            "    loss = (lin(x) ** 2).mean()\n"
+            "    loss.backward(); opt.step(); opt.clear_grad()\n"
+            "    paddle.save({'model': lin.state_dict(),\n"
+            "                 'opt': opt.state_dict(),\n"
+            "                 'step': step + 1}, ckpt)\n"
+            "    with open(trace, 'a') as f:\n"
+            "        f.write(json.dumps({'step': step,\n"
+            "            'incarnation': os.environ['PADDLE_RESTART_COUNT'],\n"
+            "            'loss': float(loss.numpy())}) + '\\n')\n"
+            "    if step == 2 and os.environ['PADDLE_RESTART_COUNT'] == '0':\n"
+            "        os._exit(17)  # simulated crash mid-training\n"
+            "print('done')\n"
+        )
+        from paddle_tpu.distributed.launch.main import launch
+
+        code = launch([
+            "--log_dir", str(tmp_path / "logs"), "--max_restarts", "2",
+            "--restart_interval", "0.1", str(script),
+        ])
+        assert code == 0
+        rows = [
+            json.loads(l)
+            for l in (tmp_path / "trace.jsonl").read_text().splitlines()
+        ]
+        # incarnation 0 ran steps 0-2, incarnation 1 resumed AT step 3
+        inc0 = [r["step"] for r in rows if r["incarnation"] == "0"]
+        inc1 = [r["step"] for r in rows if r["incarnation"] == "1"]
+        assert inc0 == [0, 1, 2]
+        assert inc1 == [3, 4, 5]
+        # loss kept decreasing across the restart (state truly resumed)
+        losses = [r["loss"] for r in rows]
+        assert losses[3] < losses[0]
+
+    def test_max_restarts_exhausted_propagates(self, tmp_path):
+        script = tmp_path / "always_bad.py"
+        script.write_text("import sys; sys.exit(9)\n")
+        from paddle_tpu.distributed.launch.main import launch
+
+        code = launch([
+            "--log_dir", str(tmp_path / "logs"), "--max_restarts", "2",
+            "--restart_interval", "0.05", str(script),
+        ])
+        assert code == 9
+
 
 class TestShardedCheckpoint:
     def test_roundtrip_same_layout(self, tmp_path):
