@@ -49,6 +49,7 @@ _tensor_patch.patch()
 
 from .autograd import grad  # noqa: E402  (needs patched Tensor)
 from . import amp  # noqa: E402
+from . import audio  # noqa: E402
 from . import autograd  # noqa: E402
 from . import framework  # noqa: E402
 from . import device  # noqa: E402
@@ -75,6 +76,7 @@ from . import nn  # noqa: E402
 from . import optimizer  # noqa: E402
 from . import quantization  # noqa: E402
 from . import regularizer  # noqa: E402
+from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
 from . import static  # noqa: E402
 from . import vision  # noqa: E402

@@ -1,0 +1,9 @@
+"""paddle.audio (ref: python/paddle/audio/__init__.py): functional
+(mel/fbank/dct/windows), features (Spectrogram/MelSpectrogram/
+LogMelSpectrogram/MFCC) and the stdlib WAV backend. The reference's
+download-backed datasets (ESC50, TESS) are omitted in this zero-egress
+image; paddle.io.Dataset covers custom audio datasets."""
+from . import backends, features, functional  # noqa: F401
+from .backends import info, load, save  # noqa: F401
+
+__all__ = ["functional", "features", "backends", "info", "load", "save"]

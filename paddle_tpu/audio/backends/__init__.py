@@ -1,0 +1,27 @@
+"""paddle.audio.backends (ref: python/paddle/audio/backends/
+{backend,wave_backend}.py): WAV load/save/info over the stdlib wave
+module — the reference's default backend does exactly this; optional
+soundfile backends are environment plugins there and out of scope in a
+zero-egress image."""
+from .wave_backend import AudioInfo, info, load, save  # noqa: F401
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            "only the stdlib wave_backend is available"
+        )
+
+
+__all__ = [
+    "info", "load", "save", "AudioInfo",
+    "list_available_backends", "get_current_backend", "set_backend",
+]

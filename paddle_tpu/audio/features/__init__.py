@@ -1,0 +1,117 @@
+"""paddle.audio.features (ref: python/paddle/audio/features/layers.py —
+Spectrogram:47, MelSpectrogram:132, LogMelSpectrogram:239, MFCC:346).
+Layers over paddle.signal.stft + audio.functional; everything is
+framework ops, so feature extraction stages under jit and rides the
+autograd tape."""
+from __future__ import annotations
+
+from ... import ops as F
+from ... import signal as _signal
+from ...nn.layer.layers import Layer
+from ..functional import (
+    compute_fbank_matrix,
+    create_dct,
+    get_window,
+    power_to_db,
+)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    """|STFT|^power of a waveform [batch, time] ->
+    [batch, n_fft//2+1, num_frames] (ref layers.py:47)."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True,
+                 pad_mode="reflect", dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or (win_length or n_fft) // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = get_window(window, self.win_length, fftbins=True).astype(dtype)
+        self.register_buffer("fft_window", w)
+
+    def forward(self, x):
+        spec = _signal.stft(
+            x, self.n_fft, self.hop_length, self.win_length,
+            self.fft_window, center=self.center, pad_mode=self.pad_mode,
+        )
+        mag = F.abs(spec)
+        if self.power != 1.0:
+            mag = F.pow(mag, F.full_like(mag, self.power))
+        return mag
+
+
+class MelSpectrogram(Layer):
+    """Spectrogram projected through a mel filterbank
+    (ref layers.py:132): [batch, n_mels, num_frames]."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(
+            n_fft, hop_length, win_length, window, power, center,
+            pad_mode, dtype,
+        )
+        fbank = compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype
+        )
+        self.register_buffer("fbank_matrix", fbank)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)               # [b, bins, frames]
+        return F.matmul(self.fbank_matrix, spec)  # [b, n_mels, frames]
+
+
+class LogMelSpectrogram(Layer):
+    """Mel spectrogram in dB (ref layers.py:239)."""
+
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype,
+        )
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return power_to_db(
+            mel, self.ref_value, self.amin, self.top_db
+        )
+
+
+class MFCC(Layer):
+    """Mel-frequency cepstral coefficients via DCT-II of the log-mel
+    (ref layers.py:346): [batch, n_mfcc, num_frames]."""
+
+    def __init__(self, sr=22050, n_mfcc=40, norm="ortho", dtype="float32",
+                 **mel_kwargs):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr=sr, dtype=dtype, **mel_kwargs
+        )
+        n_mels = self._log_melspectrogram._melspectrogram.fbank_matrix.shape[0]
+        if n_mfcc > n_mels:
+            raise ValueError(
+                f"n_mfcc ({n_mfcc}) cannot exceed n_mels ({n_mels})"
+            )
+        self.register_buffer(
+            "dct_matrix", create_dct(n_mfcc, n_mels, norm, dtype)
+        )
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)      # [b, n_mels, frames]
+        return F.einsum("mk,bmt->bkt", self.dct_matrix, logmel)

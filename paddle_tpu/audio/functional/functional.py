@@ -1,0 +1,277 @@
+"""ref: python/paddle/audio/functional/functional.py (hz_to_mel:29,
+mel_to_hz:83, mel_frequencies:126, fft_frequencies:166,
+compute_fbank_matrix:189, power_to_db:262, create_dct:306) and
+window.py's get_window dispatcher. Slaney-style mel by default, HTK
+optional, matching the reference's contracts."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ... import ops as F
+from ...core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+    "compute_fbank_matrix", "power_to_db", "create_dct", "get_window",
+]
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def hz_to_mel(freq, htk=False):
+    """ref functional.py:29."""
+    if htk:
+        if _is_tensor(freq):
+            return 2595.0 * F.log10(1.0 + freq / 700.0)
+        return 2595.0 * math.log10(1.0 + freq / 700.0)
+    # Slaney: linear below 1 kHz, log above
+    f_min, f_sp = 0.0, 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if _is_tensor(freq):
+        lin = (freq - f_min) / f_sp
+        log = min_log_mel + F.log(
+            F.clip(freq, 1e-10, None) / min_log_hz
+        ) / logstep
+        return F.where(freq >= min_log_hz, log, lin)
+    if freq >= min_log_hz:
+        return min_log_mel + math.log(freq / min_log_hz) / logstep
+    return (freq - f_min) / f_sp
+
+
+def mel_to_hz(mel, htk=False):
+    """ref functional.py:83."""
+    if htk:
+        if _is_tensor(mel):
+            return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if _is_tensor(mel):
+        lin = f_min + f_sp * mel
+        log = min_log_hz * F.exp(logstep * (mel - min_log_mel))
+        return F.where(mel >= min_log_mel, log, lin)
+    if mel >= min_log_mel:
+        return min_log_hz * math.exp(logstep * (mel - min_log_mel))
+    return f_min + f_sp * mel
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """ref functional.py:126."""
+    lo = hz_to_mel(float(f_min), htk)
+    hi = hz_to_mel(float(f_max), htk)
+    mels = F.linspace(lo, hi, n_mels, dtype)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """ref functional.py:166."""
+    return F.linspace(0, float(sr) / 2, 1 + n_fft // 2, dtype)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]
+    (ref functional.py:189)."""
+    f_max = f_max or float(sr) / 2
+    fftfreqs = fft_frequencies(sr, n_fft, dtype)            # [bins]
+    melfreqs = mel_frequencies(
+        n_mels + 2, f_min, f_max, htk, dtype
+    )                                                        # [m+2]
+    fdiff = melfreqs[1:] - melfreqs[:-1]                     # [m+1]
+    ramps = F.unsqueeze(melfreqs, [-1]) - F.unsqueeze(fftfreqs, [0])
+    lower = -ramps[:-2] / F.unsqueeze(fdiff[:-1], [-1])
+    upper = ramps[2:] / F.unsqueeze(fdiff[1:], [-1])
+    weights = F.maximum(
+        F.zeros_like(lower), F.minimum(lower, upper)
+    )
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2: n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * F.unsqueeze(enorm, [-1])
+    return weights
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """ref functional.py:262."""
+    if not _is_tensor(spect):
+        spect = to_tensor(spect)
+    log_spec = 10.0 * F.log10(F.clip(spect, amin, None))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        floor = float(F.max(log_spec).numpy()) - top_db
+        log_spec = F.clip(log_spec, floor, None)
+    return log_spec
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II basis [n_mels, n_mfcc] (ref functional.py:306)."""
+    n = np.arange(n_mels, dtype="float64")
+    k = np.arange(n_mfcc, dtype="float64")
+    basis = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        basis[:, 0] *= 1.0 / math.sqrt(2.0)
+        basis *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return to_tensor(basis.astype(dtype))
+
+
+_WINDOWS = {}
+
+
+def _register(name):
+    def deco(fn):
+        _WINDOWS[name] = fn
+        return fn
+    return deco
+
+
+def _extended(M, sym):
+    return (M + 1, True) if not sym else (M, False)
+
+
+@_register("hann")
+def _hann(M, sym=True, dtype="float64"):
+    return _cosine_sum(M, [0.5, 0.5], sym, dtype)
+
+
+@_register("hamming")
+def _hamming(M, sym=True, dtype="float64"):
+    return _cosine_sum(M, [0.54, 0.46], sym, dtype)
+
+
+@_register("blackman")
+def _blackman(M, sym=True, dtype="float64"):
+    return _cosine_sum(M, [0.42, 0.5, 0.08], sym, dtype)
+
+
+@_register("nuttall")
+def _nuttall(M, sym=True, dtype="float64"):
+    return _cosine_sum(
+        M, [0.3635819, 0.4891775, 0.1365995, 0.0106411], sym, dtype
+    )
+
+
+def _cosine_sum(M, coefs, sym, dtype):
+    m, trunc = _extended(M, sym)
+    if m == 1:
+        return np.ones(1, dtype)
+    n = np.arange(m, dtype="float64")
+    w = np.zeros(m, dtype="float64")
+    for i, a in enumerate(coefs):
+        w += (-1) ** i * a * np.cos(2 * math.pi * i * n / (m - 1))
+    w = w.astype(dtype)
+    return w[:-1] if trunc else w
+
+
+@_register("bartlett")
+def _bartlett(M, sym=True, dtype="float64"):
+    m, trunc = _extended(M, sym)
+    n = np.arange(m, dtype="float64")
+    w = 1.0 - np.abs(2.0 * n / (m - 1) - 1.0)
+    w = w.astype(dtype)
+    return w[:-1] if trunc else w
+
+
+@_register("triang")
+def _triang(M, sym=True, dtype="float64"):
+    m, trunc = _extended(M, sym)
+    n = np.arange(1, (m + 1) // 2 + 1, dtype="float64")
+    if m % 2 == 0:
+        w = (2 * n - 1.0) / m
+        w = np.concatenate([w, w[::-1]])
+    else:
+        w = 2 * n / (m + 1.0)
+        w = np.concatenate([w, w[-2::-1]])
+    w = w.astype(dtype)
+    return w[:-1] if trunc else w
+
+
+@_register("cosine")
+def _cosine(M, sym=True, dtype="float64"):
+    m, trunc = _extended(M, sym)
+    w = np.sin(math.pi / m * (np.arange(m) + 0.5)).astype(dtype)
+    return w[:-1] if trunc else w
+
+
+@_register("gaussian")
+def _gaussian(M, std=7.0, sym=True, dtype="float64"):
+    m, trunc = _extended(M, sym)
+    n = np.arange(m, dtype="float64") - (m - 1) / 2
+    w = np.exp(-(n ** 2) / (2 * std * std)).astype(dtype)
+    return w[:-1] if trunc else w
+
+
+@_register("kaiser")
+def _kaiser(M, beta=14.0, sym=True, dtype="float64"):
+    m, trunc = _extended(M, sym)
+    w = np.kaiser(m, beta).astype(dtype)
+    return w[:-1] if trunc else w
+
+
+@_register("exponential")
+def _exponential(M, center=None, tau=1.0, sym=True, dtype="float64"):
+    m, trunc = _extended(M, sym)
+    if center is None:
+        center = (m - 1) / 2
+    n = np.arange(m, dtype="float64")
+    w = np.exp(-np.abs(n - center) / tau).astype(dtype)
+    return w[:-1] if trunc else w
+
+
+@_register("bohman")
+def _bohman(M, sym=True, dtype="float64"):
+    m, trunc = _extended(M, sym)
+    fac = np.abs(np.linspace(-1, 1, m)[1:-1])
+    w = (1 - fac) * np.cos(math.pi * fac) + np.sin(math.pi * fac) / math.pi
+    w = np.concatenate([[0.0], w, [0.0]]).astype(dtype)
+    return w[:-1] if trunc else w
+
+
+@_register("tukey")
+def _tukey(M, alpha=0.5, sym=True, dtype="float64"):
+    m, trunc = _extended(M, sym)
+    if alpha <= 0:
+        w = np.ones(m)
+    elif alpha >= 1.0:
+        w = _hann(m, sym=True, dtype="float64")
+    else:
+        n = np.arange(m, dtype="float64")
+        width = int(alpha * (m - 1) / 2.0)
+        n1, n2, n3 = n[: width + 1], n[width + 1: m - width - 1], \
+            n[m - width - 1:]
+        w1 = 0.5 * (1 + np.cos(
+            math.pi * (-1 + 2.0 * n1 / alpha / (m - 1))
+        ))
+        w2 = np.ones(n2.shape[0])
+        w3 = 0.5 * (1 + np.cos(
+            math.pi * (-2.0 / alpha + 1 + 2.0 * n3 / alpha / (m - 1))
+        ))
+        w = np.concatenate([w1, w2, w3])
+    w = w.astype(dtype)
+    return w[:-1] if trunc else w
+
+
+def get_window(window, win_length, fftbins=True, dtype="float64"):
+    """ref window.py get_window: window may be a name or (name, param).
+    fftbins=True returns the periodic (sym=False) form used for STFT."""
+    if isinstance(window, (tuple, list)):
+        name, *args = window
+    else:
+        name, args = window, []
+    if name not in _WINDOWS:
+        raise ValueError(
+            f"unknown window {name!r}; supported: {sorted(_WINDOWS)}"
+        )
+    w = _WINDOWS[name](win_length, *args, sym=not fftbins, dtype=dtype)
+    return to_tensor(np.asarray(w))

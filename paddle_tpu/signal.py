@@ -1,0 +1,180 @@
+"""paddle.signal — frame / overlap_add / stft / istft.
+
+ref: python/paddle/signal.py (frame:42, overlap_add:167, stft:272,
+istft:449). The reference lowers these to dedicated frame/overlap_add
+kernels plus cuFFT; here framing is a strided gather and the FFT rides
+the paddle.fft family (XLA FFT HLO; host fallback on complex-less TPU
+backends — see ops/impl/fft_ops.py).
+"""
+from __future__ import annotations
+
+import importlib
+
+import numpy as np
+
+from . import ops as F
+from .core.tensor import Tensor, to_tensor
+
+# the submodule, not the same-named generated op (see __init__.py note)
+_fft = importlib.import_module(__package__ + ".fft")
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice a signal into overlapping frames (ref signal.py:42).
+    x: [..., seq_length] (axis=-1) -> [..., frame_length, num_frames];
+    axis=0 mirrors the reference's seq-first layout."""
+    x = _t(x)
+    if axis not in (0, -1):
+        raise ValueError(f"axis must be 0 or -1, got {axis}")
+    if axis == 0:
+        # [seq, ...] -> frame over dim 0 -> [num_frames, frame_length, ...]
+        n = x.shape[0]
+        num = 1 + (n - frame_length) // hop_length
+        starts = np.arange(num) * hop_length
+        idx = starts[:, None] + np.arange(frame_length)[None, :]
+        return F.gather(x, to_tensor(idx.reshape(-1).astype("int64")),
+                        axis=0).reshape([num, frame_length] +
+                                        list(x.shape[1:]))
+    n = x.shape[-1]
+    if frame_length > n:
+        raise ValueError(
+            f"frame_length ({frame_length}) > signal length ({n})"
+        )
+    num = 1 + (n - frame_length) // hop_length
+    starts = np.arange(num) * hop_length
+    idx = starts[:, None] + np.arange(frame_length)[None, :]  # [num, fl]
+    frames = F.gather(
+        x, to_tensor(idx.reshape(-1).astype("int64")), axis=x.ndim - 1
+    ).reshape(list(x.shape[:-1]) + [num, frame_length])
+    # reference layout: [..., frame_length, num_frames]
+    perm = list(range(frames.ndim))
+    perm[-2], perm[-1] = perm[-1], perm[-2]
+    return F.transpose(frames, perm)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (ref signal.py:167). x: [..., frame_length,
+    num_frames] -> [..., (num_frames-1)*hop + frame_length]."""
+    x = _t(x)
+    if axis not in (0, -1):
+        raise ValueError(f"axis must be 0 or -1, got {axis}")
+    if axis == 0:
+        x = F.transpose(
+            x, list(range(2, x.ndim)) + [1, 0]
+        )  # -> [..., frame_length, num_frames] then fall through
+    fl, num = x.shape[-2], x.shape[-1]
+    out_len = (num - 1) * hop_length + fl
+    batch = list(x.shape[:-2])
+    import jax.numpy as jnp
+
+    from .core import dispatch
+
+    # one scatter-add: duplicate positions accumulate
+    pos = (
+        np.arange(num)[:, None] * hop_length + np.arange(fl)[None, :]
+    ).reshape(-1)
+
+    def impl(arr):
+        flat = arr.reshape((-1, fl, num))
+        upd = jnp.swapaxes(flat, 1, 2).reshape(flat.shape[0], -1)
+        out = jnp.zeros(
+            (flat.shape[0], out_len), arr.dtype
+        ).at[:, pos].add(upd)
+        return out.reshape(batch + [out_len])
+
+    res = dispatch.call("overlap_add", impl, (x,), {})
+    if axis == 0:
+        res = F.transpose(res, [res.ndim - 1] + list(range(res.ndim - 1)))
+    return res
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """Short-time Fourier transform (ref signal.py:272).
+    x: [batch, seq] (or [seq]) -> complex [batch, n_fft//2+1, num_frames]
+    (onesided) or [batch, n_fft, num_frames]."""
+    x = _t(x)
+    squeeze = x.ndim == 1
+    if squeeze:
+        x = F.unsqueeze(x, [0])
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = F.ones([win_length], "float32")
+    window = _t(window)
+    if window.shape[0] != win_length:
+        raise ValueError("window length must equal win_length")
+    # center window inside the fft size
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        window = F.pad(window, [lp, n_fft - win_length - lp])
+    if center:
+        x = F.pad(
+            x, [n_fft // 2, n_fft // 2], mode=pad_mode
+        )
+    frames = frame(x, n_fft, hop_length)          # [b, n_fft, num]
+    frames = frames * F.unsqueeze(window, [0, -1])
+    spec_in = F.transpose(frames, [0, 2, 1])      # [b, num, n_fft]
+    out = _fft.rfft(spec_in) if onesided else _fft.fft(spec_in)
+    if normalized:
+        out = out / float(np.sqrt(n_fft))
+    out = F.transpose(out, [0, 2, 1])             # [b, bins, num]
+    return F.squeeze(out, [0]) if squeeze else out
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization
+    (ref signal.py:449)."""
+    x = _t(x)
+    squeeze = x.ndim == 2
+    if squeeze:
+        x = F.unsqueeze(x, [0])
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = F.ones([win_length], "float32")
+    window = _t(window)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        window = F.pad(window, [lp, n_fft - win_length - lp])
+
+    spec = F.transpose(x, [0, 2, 1])              # [b, num, bins]
+    if normalized:
+        spec = spec * float(np.sqrt(n_fft))
+    if onesided:
+        wave = _fft.irfft(spec, n=n_fft)          # [b, num, n_fft]
+    else:
+        wave = F.real(_fft.ifft(spec)) if not return_complex else (
+            _fft.ifft(spec)
+        )
+    wave = wave * F.unsqueeze(window, [0, 0])
+    wave = F.transpose(wave, [0, 2, 1])           # [b, n_fft, num]
+    out = overlap_add(wave, hop_length)
+
+    # window envelope for COLA normalization
+    num = x.shape[-1]
+    env = overlap_add(
+        F.tile(
+            F.unsqueeze(window * window, [0, -1]), [1, 1, num]
+        ),
+        hop_length,
+    )
+    out = out / F.clip(env, 1e-11, None)
+    if center:
+        out = out[:, n_fft // 2: out.shape[-1] - n_fft // 2]
+    if length is not None:
+        if out.shape[-1] < length:
+            # frames may not tile the padded signal exactly; the
+            # unreconstructable tail (< hop_length samples) is zero-filled
+            out = F.pad(out, [0, length - out.shape[-1]])
+        out = out[:, :length]
+    return F.squeeze(out, [0]) if squeeze else out

@@ -1,0 +1,179 @@
+"""paddle.signal (stft/istft/frame/overlap_add) and paddle.audio.
+
+Oracles: numpy/scipy (the reference tests audio against librosa values;
+scipy.signal provides the same window/STFT contracts).
+"""
+import numpy as np
+import pytest
+import scipy.signal
+
+import paddle_tpu as paddle
+import paddle_tpu.signal as S
+
+
+def _sine(sr=8000, f=440.0, secs=0.5):
+    t = np.linspace(0, secs, int(sr * secs), endpoint=False)
+    return (0.5 * np.sin(2 * np.pi * f * t)).astype("float32")
+
+
+class TestSignal:
+    def test_frame_layout(self):
+        x = paddle.to_tensor(np.arange(10, dtype="float32"))
+        fr = S.frame(x, frame_length=4, hop_length=2)
+        assert fr.shape == [4, 4]  # [frame_length, num_frames]
+        np.testing.assert_array_equal(fr.numpy()[:, 0], [0, 1, 2, 3])
+        np.testing.assert_array_equal(fr.numpy()[:, 1], [2, 3, 4, 5])
+
+    def test_overlap_add_inverts_frame_hop_eq_len(self):
+        x = paddle.to_tensor(np.arange(12, dtype="float32"))
+        fr = S.frame(x, frame_length=4, hop_length=4)
+        back = S.overlap_add(fr, hop_length=4)
+        np.testing.assert_allclose(back.numpy(), x.numpy())
+
+    def test_stft_matches_scipy(self):
+        wav = _sine()
+        n_fft, hop = 256, 64
+        win = paddle.audio.functional.get_window(
+            "hann", n_fft
+        ).astype("float32")
+        got = S.stft(
+            paddle.to_tensor(wav[None]), n_fft, hop, window=win,
+            center=False,
+        ).numpy()[0]
+        _, _, ref = scipy.signal.stft(
+            wav, nperseg=n_fft, noverlap=n_fft - hop,
+            window="hann", boundary=None, padded=False,
+        )
+        # scipy normalizes by window.sum(); rescale to raw stft
+        ref = ref * np.hanning(n_fft).sum()
+        n = min(got.shape[-1], ref.shape[-1])
+        np.testing.assert_allclose(
+            np.abs(got[:, :n]), np.abs(ref[:, :n]), rtol=1e-3, atol=1e-3
+        )
+
+    def test_istft_roundtrip(self):
+        wav = _sine()
+        win = paddle.audio.functional.get_window(
+            "hann", 256
+        ).astype("float32")
+        spec = S.stft(paddle.to_tensor(wav[None]), 256, 64, window=win)
+        rec = S.istft(
+            spec, 256, 64, window=win, length=wav.shape[0]
+        ).numpy()[0]
+        np.testing.assert_allclose(rec, wav, atol=1e-4)
+
+
+class TestAudioFunctional:
+    @pytest.mark.parametrize("name", [
+        "hann", "hamming", "blackman", "bartlett", "nuttall", "cosine",
+        "triang", "bohman", "tukey",
+    ])
+    def test_windows_match_scipy(self, name):
+        got = paddle.audio.functional.get_window(
+            name, 64, fftbins=True
+        ).numpy()
+        ref = scipy.signal.get_window(name, 64, fftbins=True)
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-8)
+
+    def test_kaiser_gaussian(self):
+        got = paddle.audio.functional.get_window(
+            ("kaiser", 14.0), 64
+        ).numpy()
+        ref = scipy.signal.get_window(("kaiser", 14.0), 64)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-7)
+        got = paddle.audio.functional.get_window(
+            ("gaussian", 7.0), 64
+        ).numpy()
+        ref = scipy.signal.get_window(("gaussian", 7.0), 64)
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-8)
+
+    def test_mel_conversions_roundtrip(self):
+        from paddle_tpu.audio.functional import hz_to_mel, mel_to_hz
+
+        for hz in (60.0, 440.0, 4000.0):
+            for htk in (False, True):
+                assert abs(
+                    mel_to_hz(hz_to_mel(hz, htk), htk) - hz
+                ) < 1e-6 * max(hz, 1)
+
+    def test_fbank_matrix_properties(self):
+        fb = paddle.audio.functional.compute_fbank_matrix(
+            sr=8000, n_fft=256, n_mels=20
+        ).numpy()
+        assert fb.shape == (20, 129)
+        assert (fb >= 0).all()
+        # every filter has some support
+        assert (fb.sum(-1) > 0).all()
+
+    def test_power_to_db(self):
+        x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], "float32"))
+        db = paddle.audio.functional.power_to_db(x, top_db=None).numpy()
+        np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-5)
+        db2 = paddle.audio.functional.power_to_db(x, top_db=15.0).numpy()
+        assert db2.min() >= 20.0 - 15.0 - 1e-5
+
+    def test_create_dct_orthonormal(self):
+        d = paddle.audio.functional.create_dct(8, 8, norm="ortho").numpy()
+        np.testing.assert_allclose(d.T @ d, np.eye(8), atol=1e-5)
+
+
+class TestAudioFeatures:
+    def test_spectrogram_peak_frequency(self):
+        sr, f = 8000, 440.0
+        wav = paddle.to_tensor(_sine(sr, f)[None])
+        sp = paddle.audio.features.Spectrogram(
+            n_fft=512, hop_length=128
+        )(wav)
+        peak = int(sp.numpy()[0].mean(-1).argmax())
+        assert abs(peak - f * 512 / sr) <= 1
+
+    def test_melspectrogram_and_mfcc_shapes(self):
+        wav = paddle.to_tensor(_sine()[None])
+        mel = paddle.audio.features.MelSpectrogram(
+            sr=8000, n_fft=256, hop_length=64, n_mels=32
+        )(wav)
+        assert mel.shape[:2] == [1, 32]
+        mfcc = paddle.audio.features.MFCC(
+            sr=8000, n_mfcc=13, n_fft=256, hop_length=64, n_mels=32
+        )(wav)
+        assert mfcc.shape[:2] == [1, 13]
+        with pytest.raises(ValueError):
+            paddle.audio.features.MFCC(sr=8000, n_mfcc=64, n_mels=32)
+
+    def test_features_differentiable(self):
+        wav = paddle.to_tensor(_sine()[None])
+        wav.stop_gradient = False
+        mel = paddle.audio.features.LogMelSpectrogram(
+            sr=8000, n_fft=256, hop_length=64, n_mels=16
+        )(wav)
+        mel.sum().backward()
+        assert wav.grad is not None
+        assert np.isfinite(wav.grad.numpy()).all()
+
+
+class TestWaveBackend:
+    def test_save_load_roundtrip(self, tmp_path):
+        sr = 8000
+        wav = _sine(sr)[None]
+        path = str(tmp_path / "t.wav")
+        paddle.audio.save(path, paddle.to_tensor(wav), sr)
+        meta = paddle.audio.backends.info(path)
+        assert meta.sample_rate == sr
+        assert meta.num_channels == 1
+        assert meta.bits_per_sample == 16
+        back, sr2 = paddle.audio.load(path)
+        assert sr2 == sr
+        np.testing.assert_allclose(
+            back.numpy(), wav, atol=1.0 / 32768 * 2
+        )
+
+    def test_partial_load(self, tmp_path):
+        sr = 8000
+        wav = _sine(sr)[None]
+        path = str(tmp_path / "t.wav")
+        paddle.audio.save(path, paddle.to_tensor(wav), sr)
+        seg, _ = paddle.audio.load(path, frame_offset=100, num_frames=50)
+        assert seg.shape == [1, 50]
+        np.testing.assert_allclose(
+            seg.numpy(), wav[:, 100:150], atol=1.0 / 32768 * 2
+        )
