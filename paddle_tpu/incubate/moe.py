@@ -62,9 +62,12 @@ class TopKGate(Layer):
         combine_parts = []
         # position counters per expert, built via cumsum of assignments
         occupancy = None
+        top1_onehot = None
         for _ in range(self.k):
             idx = F.argmax(remaining, -1)          # [s]
             onehot = F.one_hot(idx, e)             # [s, e]
+            if top1_onehot is None:
+                top1_onehot = onehot
             # position of each token within its chosen expert's buffer
             prev = occupancy if occupancy is not None else None
             running = F.cumsum(onehot, 0) - onehot  # exclusive prefix count
@@ -95,9 +98,13 @@ class TopKGate(Layer):
         denom = F.sum(combine, [1, 2], keepdim=True) + 1e-9
         combine = combine / denom
 
-        # GShard aux load-balancing loss: e * sum(mean_gate * mean_assign)
+        # GShard aux load-balancing loss: e * sum(mean_gate * top1_fraction)
+        # ce is the PRE-capacity top-1 dispatch fraction (the paper's
+        # c_e/S), matching the sort-based fast path (ops/impl/moe_ops.py) —
+        # all-k post-capacity counting would rescale the loss by ~k and
+        # couple it to capacity drops
         me = F.mean(gates, 0)                      # [e]
-        ce = F.mean(F.sum(dispatch, 2), 0)         # [e] fraction routed
+        ce = F.mean(top1_onehot, 0)                # [e]
         aux = F.sum(me * ce) * float(e)
         return dispatch, combine, aux
 

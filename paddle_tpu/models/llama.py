@@ -295,6 +295,15 @@ class LlamaForCausalLM(GenerationMixin, Layer):
 
     def forward(self, input_ids, labels=None, attn_mask=None, caches=None,
                 position=None):
+        """Return contract, by arguments:
+          * ``caches`` given (decode): returns ``(logits, new_caches)``.
+          * ``labels=None``: returns bare ``logits``.
+          * ``labels`` given: returns ``(logits, loss)`` — EXCEPT when
+            ``config.fused_loss_chunk > 0``: then the LM head is fused
+            into the chunked loss (fused_linear_cross_entropy), full
+            [b, s, vocab] logits never materialize, and the return is
+            ``(None, loss)``. Callers needing logits must set
+            ``fused_loss_chunk=0`` (or call without labels)."""
         if caches is not None:
             hidden, new_caches = self.llama(
                 input_ids, attn_mask, caches=caches, position=position
@@ -372,7 +381,8 @@ class LlamaPipeline:
     """
 
     def __init__(self, model, mesh, axis_name="pp", num_micro_batches=None,
-                 schedule="1f1b", remat=False, data_axis=None):
+                 schedule="1f1b", remat=False, data_axis=None,
+                 tp_axis=None, dtype=None, virtual_pp=1):
         from ..core.tensor import Tensor as _T
 
         cfg = model.config
@@ -385,20 +395,39 @@ class LlamaPipeline:
                 "LlamaPipeline: tied embeddings not supported; the edge "
                 "stages own separate embed/head weights"
             )
-        if schedule not in ("1f1b", "gpipe"):
+        if schedule not in ("1f1b", "gpipe", "vpp", "zero_bubble"):
             raise ValueError(f"unknown schedule {schedule!r}")
-        if schedule == "1f1b" and remat:
+        if schedule in ("1f1b", "zero_bubble") and remat:
             raise ValueError(
-                "remat applies to the gpipe schedule only; 1F1B is "
-                "inherently recompute-based (stages re-run in its "
-                "backward micro-steps)"
+                "remat applies to the gpipe/vpp schedules only; 1F1B and "
+                "zero-bubble are inherently recompute-based (stages re-run "
+                "in their backward micro-steps)"
             )
+        if schedule == "vpp" and virtual_pp < 2:
+            raise ValueError("vpp needs virtual_pp >= 2")
+        if schedule != "vpp":
+            virtual_pp = 1
         n_stages = mesh.get_dim_size(axis_name)
         L = cfg.num_hidden_layers
-        if L % n_stages:
+        if L % (n_stages * virtual_pp):
             raise ValueError(
-                f"num_hidden_layers {L} not divisible by {n_stages} stages"
+                f"num_hidden_layers {L} not divisible by "
+                f"{n_stages} stages x {virtual_pp} virtual chunks"
             )
+        tp = mesh.get_dim_size(tp_axis) if tp_axis else 1
+        if tp > 1:
+            # Megatron TP inside the pipelined region: heads and FFN
+            # columns split over the tp axis; vocab-parallel loss
+            if cfg.num_attention_heads % tp or cfg.num_key_value_heads % tp:
+                raise ValueError(
+                    f"attention heads ({cfg.num_attention_heads}/"
+                    f"{cfg.num_key_value_heads} kv) not divisible by "
+                    f"tp={tp}"
+                )
+            if cfg.intermediate_size % tp or cfg.vocab_size % tp:
+                raise ValueError(
+                    f"intermediate_size/vocab_size not divisible by tp={tp}"
+                )
         self.cfg = cfg
         self.mesh = mesh
         self.axis_name = axis_name
@@ -406,15 +435,32 @@ class LlamaPipeline:
         self.schedule = schedule
         self.remat = remat
         self.data_axis = data_axis
+        self.tp_axis = tp_axis if tp > 1 else None
+        self.virtual_pp = virtual_pp
         # caller-owned compile cache: the pipeline re-uses one jitted
         # program per shape across training steps
         self._compile_cache = {}
-        lps = L // n_stages
+        lps = L // (n_stages * virtual_pp)
+
+        import jax.numpy as _jnp
 
         def stk(get):
-            arrs = [np.asarray(get(model.llama.layers[i]).numpy())
-                    for i in range(L)]
-            a = np.stack(arrs).reshape((n_stages, lps) + arrs[0].shape)
+            # stack on-device (no numpy round trip — at 8B scale the
+            # host copy dominated wall clock)
+            arrs = [get(model.llama.layers[i])._data for i in range(L)]
+            if dtype:
+                arrs = [a.astype(dtype) for a in arrs]
+            a = _jnp.stack(arrs)
+            if virtual_pp > 1:
+                # [v, p, lps, ...] then swap -> [p, v, lps, ...]: entry
+                # [d, c] = logical stage c*p + d (interleaved mapping,
+                # ref pipeline_parallel.py:1172 chunk assignment)
+                a = _jnp.swapaxes(
+                    a.reshape((virtual_pp, n_stages, lps) + a.shape[1:]),
+                    0, 1,
+                )
+            else:
+                a = a.reshape((n_stages, lps) + a.shape[1:])
             t = _T(a)
             t.stop_gradient = False
             return t
@@ -432,7 +478,10 @@ class LlamaPipeline:
         }
 
         def own(t):
-            c = _T(np.asarray(t.numpy()))
+            a = t._data
+            if dtype:
+                a = a.astype(dtype)
+            c = _T(a + 0)  # fresh buffer, pipeline owns its copy
             c.stop_gradient = False
             return c
 
@@ -447,6 +496,8 @@ class LlamaPipeline:
         n_heads = cfg.num_attention_heads
         n_kv = cfg.num_key_value_heads
         hd = cfg.hidden_size // n_heads
+        nh_l, nkv_l = n_heads // tp, n_kv // tp  # per-tp-device heads
+        tp_ax = self.tp_axis
 
         from ..ops.impl.activation import swiglu as _swiglu
         from ..ops.impl.fused_ops import rope_qk as _rope
@@ -458,20 +509,32 @@ class LlamaPipeline:
         import jax.numpy as jnp
 
         def block(bp, h):
+            # Megatron pattern when tp_ax is set: q/k/v/gate/up are
+            # column-parallel (weights arrive as local column shards via
+            # the tp placements), o/down are row-parallel with one psum
+            # each; activations between blocks stay replicated over tp
+            # (unvarying — shard_map's type system transposes grads
+            # exactly, see distributed/pipeline.py scaffold docstring)
             x = _rms(h, bp["ln1"], epsilon=eps)
             b, s = x.shape[0], x.shape[1]
-            q = (x @ bp["wq"]).reshape(b, s, n_heads, hd)
-            k = (x @ bp["wk"]).reshape(b, s, n_kv, hd)
-            v = (x @ bp["wv"]).reshape(b, s, n_kv, hd)
+            q = (x @ bp["wq"]).reshape(b, s, nh_l, hd)
+            k = (x @ bp["wk"]).reshape(b, s, nkv_l, hd)
+            v = (x @ bp["wv"]).reshape(b, s, nkv_l, hd)
             q, k = _rope(q, k, base=theta)
-            if n_kv != n_heads:
-                rep = n_heads // n_kv
+            if nkv_l != nh_l:
+                rep = nh_l // nkv_l
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
             o = _sdpa(q, k, v, is_causal=True)
-            h = h + o.reshape(b, s, n_heads * hd) @ bp["wo"]
+            part = o.reshape(b, s, nh_l * hd) @ bp["wo"]
+            if tp_ax:
+                part = jax.lax.psum(part, tp_ax)
+            h = h + part
             x = _rms(h, bp["ln2"], epsilon=eps)
-            h = h + _swiglu(x @ bp["wg"], x @ bp["wu"]) @ bp["wd"]
+            part = _swiglu(x @ bp["wg"], x @ bp["wu"]) @ bp["wd"]
+            if tp_ax:
+                part = jax.lax.psum(part, tp_ax)
+            h = h + part
             return h
 
         def stage_fn(sp, h):
@@ -485,33 +548,73 @@ class LlamaPipeline:
 
         def last_fn(lp, h, labels):
             h = _rms(h, lp["norm"], epsilon=eps)
-            logits = h[:, :-1] @ lp["head"]
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            ll = jnp.take_along_axis(
-                logp, labels[:, 1:][..., None].astype(jnp.int32), axis=-1
+            logits = (h[:, :-1] @ lp["head"]).astype(jnp.float32)
+            lbl = labels[:, 1:].astype(jnp.int32)
+            if tp_ax is None:
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                ll = jnp.take_along_axis(logp, lbl[..., None], axis=-1)
+                return -ll.mean()
+            # vocab-parallel softmax cross entropy (the reference's
+            # c_softmax_with_cross_entropy_op.cu contract): head is a
+            # vocab column shard; lse and the gold logit are assembled
+            # with psums over tp. The max shift is a constant offset
+            # (stop_gradient), keeping the grad the exact softmax.
+            r = jax.lax.axis_index(tp_ax)
+            vl = logits.shape[-1]
+            # stop_gradient INSIDE pmax: the collective has no diff rule,
+            # but with a zero-tangent operand it is never differentiated;
+            # the shift is a constant so the grad stays the exact softmax
+            m = jax.lax.pmax(
+                jax.lax.stop_gradient(logits.max(-1)), tp_ax
             )
-            return -ll.mean()
+            se = jax.lax.psum(
+                jnp.exp(logits - m[..., None]).sum(-1), tp_ax
+            )
+            loc = lbl - r * vl
+            inr = jnp.logical_and(loc >= 0, loc < vl)
+            safe = jnp.clip(loc, 0, vl - 1)
+            gold_l = jnp.take_along_axis(logits, safe[..., None], -1)[..., 0]
+            gold = jax.lax.psum(jnp.where(inr, gold_l, 0.0), tp_ax)
+            return (jnp.log(se) + m - gold).mean()
 
         self._fns = (first_fn, stage_fn, last_fn)
+        off = 1 if virtual_pp > 1 else 0  # extra leading chunk dim
+        self._stacked_tp_dims = (
+            {k: d + off for k, d in
+             {"wq": 3, "wk": 3, "wv": 3, "wg": 3, "wu": 3,
+              "wo": 2, "wd": 2}.items()}
+            if self.tp_axis else None
+        )
+        self._last_tp_dims = {"head": 1} if self.tp_axis else None
 
     def __call__(self, input_ids, labels):
-        from ..distributed.pipeline import pipeline_1f1b, pipeline_program
+        from ..distributed.pipeline import (
+            pipeline_1f1b,
+            pipeline_program,
+            pipeline_vpp,
+            pipeline_zero_bubble,
+        )
 
         first_fn, stage_fn, last_fn = self._fns
         kw = dict(
             mesh=self.mesh, axis_name=self.axis_name,
             num_micro_batches=self.num_micro_batches,
-            data_axis=self.data_axis, cache=self._compile_cache,
+            data_axis=self.data_axis, tp_axis=self.tp_axis,
+            stacked_tp_dims=self._stacked_tp_dims,
+            last_tp_dims=self._last_tp_dims, cache=self._compile_cache,
         )
+        args = (first_fn, stage_fn, last_fn, self.first, self.stages,
+                self.last, input_ids, labels)
         if self.schedule == "1f1b":
-            return pipeline_1f1b(
-                first_fn, stage_fn, last_fn, self.first, self.stages,
-                self.last, input_ids, labels, **kw,
+            return pipeline_1f1b(*args, **kw)
+        if self.schedule == "zero_bubble":
+            return pipeline_zero_bubble(*args, **kw)
+        if self.schedule == "vpp":
+            return pipeline_vpp(
+                *args, virtual_chunks=self.virtual_pp, remat=self.remat,
+                **kw,
             )
-        return pipeline_program(
-            first_fn, stage_fn, last_fn, self.first, self.stages,
-            self.last, input_ids, labels, remat=self.remat, **kw,
-        )
+        return pipeline_program(*args, remat=self.remat, **kw)
 
     def parameters(self):
         return (

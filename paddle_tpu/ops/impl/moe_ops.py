@@ -49,8 +49,15 @@ def moe_gate_dispatch(x, gate_logits, *, k=2, capacity=0,
     # assignments outrank second choices, ties by token order — sort by
     # the composite (expert, choice_rank, token) key
     ar = jnp.arange(s * k, dtype=jnp.int32)
-    composite = flat_e * (s * k) + (ar % k) * s + ar // k
-    order = jnp.argsort(composite)
+    if e * (s * k) >= 2 ** 31:
+        # composite key would overflow int32: sort lexicographically via
+        # two stable argsorts (secondary key first, then expert)
+        rank2 = (ar % k) * s + ar // k
+        pre = jnp.argsort(rank2)
+        order = pre[jnp.argsort(flat_e[pre], stable=True)]
+    else:
+        composite = flat_e * (s * k) + (ar % k) * s + ar // k
+        order = jnp.argsort(composite)
     sorted_e = flat_e[order]
     seg_start = jnp.searchsorted(
         sorted_e, jnp.arange(e, dtype=sorted_e.dtype), side="left"
@@ -80,11 +87,12 @@ def moe_gate_dispatch(x, gate_logits, *, k=2, capacity=0,
         kept_w = vals * (slots >= 0).astype(vals.dtype)
         vals = kept_w / (kept_w.sum(-1, keepdims=True) + 1e-9)
 
-    # GShard load-balancing aux: e * sum(mean_gate * assigned_fraction)
+    # GShard load-balancing aux: e * sum(mean_gate * top1_fraction).
+    # ce is the PRE-capacity top-1 dispatch fraction (the paper's c_e/S) —
+    # counting all k kept assignments would rescale the loss by ~k and
+    # couple it to capacity drops
     me = gates.mean(0)                                # [e]
-    ce = jnp.zeros((e,), jnp.float32).at[esc].add(
-        jnp.where(keep, 1.0 / s, 0.0), mode="drop"
-    )
+    ce = jnp.zeros((e,), jnp.float32).at[idx[:, 0]].add(1.0 / s)
     aux = jnp.sum(me * ce) * float(e)
     n_dropped = jnp.sum(~keep).astype(jnp.int32)
     return (dispatched, vals.astype(x.dtype), idx.astype(jnp.int32),

