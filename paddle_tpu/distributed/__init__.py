@@ -51,11 +51,21 @@ from .pipeline import (
     pipeline_1f1b,
     pipeline_apply,
     pipeline_program,
+    pipeline_vpp,
+    pipeline_zero_bubble,
+    schedule_bubble_fraction,
+)
+from .parallelize import (
+    ColWiseParallel,
+    PipelineParallel,
+    RowWiseParallel,
+    parallelize,
 )
 from .recompute import recompute, recompute_sequential
 from .placement import Partial, Placement, Replicate, Shard
 from .sequence_parallel import gather_sequence, ring_attention, split_sequence
 from .process_mesh import ProcessMesh
+from .store import TCPStore
 
 _dispatch.set_dist_hook(_dist_dispatch)
 
@@ -68,10 +78,12 @@ __all__ = [
     "reduce_scatter", "scatter", "barrier",
     "ring_attention", "split_sequence", "gather_sequence",
     "pipeline_apply", "pipeline_program", "pipeline_1f1b", "PipelineStages",
+    "pipeline_vpp", "pipeline_zero_bubble", "schedule_bubble_fraction",
     "recompute", "recompute_sequential",
+    "parallelize", "ColWiseParallel", "RowWiseParallel", "PipelineParallel",
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
     "DataParallel", "shard_layer", "shard_optimizer", "default_mesh",
     "ShardingStage1", "ShardingStage2", "ShardingStage3",
     "group_sharded_parallel",
-    "checkpoint",
+    "checkpoint", "TCPStore",
 ]

@@ -51,8 +51,42 @@ from .process_mesh import ProcessMesh
 
 __all__ = [
     "pipeline_apply", "pipeline_program", "pipeline_1f1b",
+    "pipeline_vpp", "pipeline_zero_bubble", "schedule_bubble_fraction",
     "PipelineStages",
 ]
+
+
+def schedule_bubble_fraction(schedule, n_stages, n_micro, virtual_chunks=1):
+    """Analytic bubble fraction per schedule, in the reference's machine
+    model (each device executes one op at a time; F = dX = dW = 1 time
+    unit, full B = dX + dW = 2):
+
+      gpipe:       (p-1) / (m + p - 1)
+      vpp:         (p-1) / (v*m + p - 1)      -- interleave divides by v
+      1f1b:        (p-1) / (m + p - 1)        -- same ratio as gpipe;
+                                                 the win is the O(p)
+                                                 activation stash
+      zero_bubble: (p-1) / (3m + p - 1)       -- ZBH1: dW off the
+                                                 dependency chain fills
+                                                 the drain (~1/3 of 1F1B)
+
+    ref: fleet/meta_parallel/pipeline_parallel.py:1172 (VPP) and
+    distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py.
+    NOTE: in this framework's single-XLA-program formulation every
+    schedule compiles to one scan of masked ticks and XLA overlaps the
+    F/dX/dW streams inside a tick; these fractions describe the schedule
+    semantics (and the reference hardware model), not our wall clock.
+    """
+    p, m, v = n_stages, n_micro, virtual_chunks
+    if schedule == "gpipe":
+        return (p - 1) / (m + p - 1)
+    if schedule == "vpp":
+        return (p - 1) / (v * m + p - 1)
+    if schedule == "1f1b":
+        return (p - 1) / (m + p - 1)
+    if schedule == "zero_bubble":
+        return (p - 1) / (3 * m + p - 1)
+    raise ValueError(f"unknown schedule {schedule!r}")
 
 
 def _pipeline_local(params_local, xs, *, stage_fn, axis_name, n_micro):
@@ -218,11 +252,50 @@ class PipelineStages:
 # --------------------------------------------------------------------------
 
 
-def _edge_spec(tree):
+def _param_spec(p, mesh):
+    """PartitionSpec implied by a param's dist placements (replicated
+    when it has none)."""
+    meta = getattr(p, "_dist_meta", None)
+    if meta is None:
+        return PartitionSpec()
+    entries = [None] * p.ndim
+    for mesh_dim, pl in enumerate(meta.placements):
+        if pl.is_shard():
+            d = pl.get_dim()
+            name = mesh.dim_names[mesh_dim]
+            cur = entries[d]
+            if cur is None:
+                entries[d] = name
+            else:
+                cur = cur if isinstance(cur, tuple) else (cur,)
+                entries[d] = cur + (name,)
+    return PartitionSpec(*entries)
+
+
+def _derived_spec(tree, mesh):
     return jax.tree_util.tree_map(
-        lambda _: PartitionSpec(), tree,
+        lambda p: _param_spec(p, mesh), tree,
         is_leaf=lambda v: isinstance(v, Tensor),
     )
+
+
+def _shard_edge_tp(params, mesh, tp_axis, tp_dims):
+    """Lay edge params (dict) over the tp axis per ``tp_dims``
+    (key -> tensor dim; missing/None = replicated)."""
+    if not tp_axis or not tp_dims:
+        return params
+    tp_idx = mesh.dim_names.index(tp_axis)
+    for key, p in params.items():
+        d = tp_dims.get(key)
+        if d is None or not isinstance(p, Tensor):
+            continue
+        if p._dist_meta is None:
+            placements = [Replicate()] * mesh.ndim
+            placements[tp_idx] = Shard(d)
+            t = shard_tensor(p, mesh, placements,
+                             stop_gradient=p.stop_gradient)
+            p._rebind(t._data, dist_meta=t._dist_meta)
+    return params
 
 
 def _shape_key(*trees):
@@ -239,14 +312,24 @@ def _shape_key(*trees):
 
 
 def _pipeline_scaffold(first_params, stacked_params, last_params,
-                       mesh, axis_name, data_axis):
-    """Shared plumbing for both schedules: shard stacked params, build
-    specs, flatten the three param trees."""
-    stacked_params = _prep_stacked(stacked_params, mesh, axis_name)
-    stacked_spec = jax.tree_util.tree_map(
-        lambda p: PartitionSpec(*([axis_name] + [None] * (p.ndim - 1))),
-        stacked_params, is_leaf=lambda v: isinstance(v, Tensor),
-    )
+                       mesh, axis_name, data_axis, tp_axis=None,
+                       stacked_tp_dims=None, last_tp_dims=None):
+    """Shared plumbing for both schedules: shard stacked (+ tp-sharded
+    edge) params, derive specs from the resulting placements, flatten the
+    three param trees. With ``tp_axis``, ``stacked_tp_dims``/
+    ``last_tp_dims`` (dict key -> tensor dim) add Megatron-style TP
+    placements; the stage/last fns are then expected to psum over
+    ``tp_axis`` where the math requires (row-parallel outputs,
+    vocab-parallel loss). Grad correctness for both outer AD (gpipe) and
+    the inline vjp (1F1B) rides shard_map's varying-type transposition —
+    replicated-over-tp activations stay unvarying, so no manual psum of
+    replica grads is needed."""
+    stacked_params = _prep_stacked(stacked_params, mesh, axis_name,
+                                   tp_axis=tp_axis, tp_dims=stacked_tp_dims)
+    last_params = _shard_edge_tp(last_params, mesh, tp_axis, last_tp_dims)
+    stacked_spec = _derived_spec(stacked_params, mesh)
+    first_spec = _derived_spec(first_params, mesh)
+    last_spec = _derived_spec(last_params, mesh)
     data_spec = PartitionSpec(None, data_axis)  # [nm, mb, ...] mb over dp
     f_flat, f_tree = jax.tree_util.tree_flatten(
         first_params, is_leaf=lambda v: isinstance(v, Tensor))
@@ -254,7 +337,7 @@ def _pipeline_scaffold(first_params, stacked_params, last_params,
         stacked_params, is_leaf=lambda v: isinstance(v, Tensor))
     l_flat, l_tree = jax.tree_util.tree_flatten(
         last_params, is_leaf=lambda v: isinstance(v, Tensor))
-    return (stacked_params, stacked_spec, data_spec,
+    return (stacked_params, stacked_spec, first_spec, last_spec, data_spec,
             (f_flat, f_tree), (s_flat, s_tree), (l_flat, l_tree))
 
 
@@ -325,22 +408,36 @@ def _pipeline_lm_local(first_arrays, stage_arrays, last_arrays, xs, aux,
     return loss
 
 
-def _prep_stacked(stacked_params, mesh, axis_name):
+def _prep_stacked(stacked_params, mesh, axis_name, tp_axis=None,
+                  tp_dims=None):
     """Shard stage-stacked param Tensors over the pp axis (in place),
-    mirroring pipeline_apply's layout step."""
+    mirroring pipeline_apply's layout step. ``tp_dims`` (dict key ->
+    tensor dim, requires dict-shaped params) adds a tp-axis Shard on
+    that dim (Megatron col/row-parallel weight layout)."""
     axis_idx = mesh.dim_names.index(axis_name)
+    tp_idx = mesh.dim_names.index(tp_axis) if tp_axis else None
 
-    def _prep(p):
+    def _prep(p, td=None):
         if isinstance(p, Tensor):
             if p._dist_meta is None:
                 placements = [Replicate()] * mesh.ndim
                 placements[axis_idx] = Shard(0)
+                if tp_idx is not None and td is not None:
+                    placements[tp_idx] = Shard(td)
                 d = shard_tensor(p, mesh, placements,
                                  stop_gradient=p.stop_gradient)
                 p._rebind(d._data, dist_meta=d._dist_meta)
             return p
         return Tensor(jnp.asarray(p))
 
+    if tp_dims:
+        if not isinstance(stacked_params, dict):
+            raise ValueError(
+                "tp_dims requires dict-shaped stacked_params"
+            )
+        return {
+            k: _prep(v, tp_dims.get(k)) for k, v in stacked_params.items()
+        }
     return jax.tree_util.tree_map(
         _prep, stacked_params, is_leaf=lambda v: isinstance(v, Tensor)
     )
@@ -369,6 +466,7 @@ def pipeline_program(first_fn, stage_fn, last_fn, first_params,
                      stacked_params, last_params, x, aux=None, *,
                      mesh: ProcessMesh, axis_name="pp",
                      num_micro_batches=None, remat=False, data_axis=None,
+                     tp_axis=None, stacked_tp_dims=None, last_tp_dims=None,
                      cache=None):
     """GPipe schedule with embedding/head inside the pipelined region.
 
@@ -388,13 +486,14 @@ def pipeline_program(first_fn, stage_fn, last_fn, first_params,
         x = Tensor(x)
     if aux is not None and not isinstance(aux, Tensor):
         aux = Tensor(aux)
-    (stacked_params, stacked_spec, data_spec,
+    (stacked_params, stacked_spec, first_spec, last_spec, data_spec,
      (f_flat, f_tree), (s_flat, s_tree), (l_flat, l_tree)) = (
         _pipeline_scaffold(first_params, stacked_params, last_params,
-                           mesh, axis_name, data_axis)
+                           mesh, axis_name, data_axis, tp_axis,
+                           stacked_tp_dims, last_tp_dims)
     )
     ckey = ("gpipe", _shape_key(x, aux, first_params, stacked_params,
-                                last_params), nm, remat, data_axis)
+                                last_params), nm, remat, data_axis, tp_axis)
     mapped = None if cache is None else cache.get(ckey)
     if mapped is None:
         local = functools.partial(
@@ -408,8 +507,8 @@ def pipeline_program(first_fn, stage_fn, last_fn, first_params,
         # identity stable across steps so XLA compiles once per shape
         mapped = jax.jit(jax.shard_map(
             local, mesh=mesh.jax_mesh(),
-            in_specs=(_edge_spec(first_params), stacked_spec,
-                      _edge_spec(last_params), data_spec,
+            in_specs=(first_spec, stacked_spec,
+                      last_spec, data_spec,
                       data_spec if aux is not None else None),
             out_specs=PartitionSpec(),
         ))
@@ -430,6 +529,171 @@ def pipeline_program(first_fn, stage_fn, last_fn, first_params,
 
     return _dispatch_pipeline(
         "pipeline_program", impl, [x] + f_flat + s_flat + l_flat,
+        (x,) + tuple(f_flat) + tuple(s_flat) + tuple(l_flat),
+    )
+
+
+# --------------------------------------------------------------------------
+# VPP: interleaved virtual pipeline stages.  ref: the reference's
+# PipelineParallelWithInterleave (fleet/meta_parallel/pipeline_parallel.py
+# :1172) and the static VPP pass (pipeline_scheduler_pass/pipeline_vpp.py).
+# Each device owns `v` chunks of layers; logical stage l = c*p + d lives on
+# device d as chunk c, so an activation leaving the last device wraps to
+# device 0 for its next chunk (the existing ppermute ring already wraps).
+# Chunk sweeps are overlapped: chunk c's sweep starts at tick c*m, which is
+# conflict-free iff m >= p (enforced); T = v*m + p - 1 ticks, so the
+# fill/drain bubble drops to (p-1)/(v*m + p - 1) — GPipe's divided by ~v.
+# Backward is jax.grad of the scan, like pipeline_program.
+# --------------------------------------------------------------------------
+
+
+def _pipeline_vpp_local(first_arrays, stage_arrays, last_arrays, xs, aux,
+                        *, first_fn, stage_fn, last_fn, axis_name, n_micro,
+                        n_chunks, remat, data_axis=None):
+    """stage_arrays leaves: [1, v, lps_v, ...] (pp-sharded dim 0)."""
+    n_stages = jax.lax.psum(1, axis_name)
+    stage_idx = jax.lax.axis_index(axis_name)
+    chunks = jax.tree_util.tree_map(lambda p: p[0], stage_arrays)
+    sfn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    hidden = jax.eval_shape(first_fn, first_arrays, xs[0])
+    vaxes = (axis_name,) + ((data_axis,) if data_axis is not None else ())
+    carry0 = jax.lax.pcast(
+        jnp.zeros(hidden.shape, hidden.dtype), vaxes, to="varying"
+    )
+    # wrap FIFO: activations finishing chunk c on the last device arrive
+    # at device 0 up to (m - p) ticks before chunk c+1 consumes them
+    # (arrival tick c*m + mb + p vs consumption (c+1)*m + mb); a slot per
+    # micro-batch id is safe — the slot is rewritten once per sweep,
+    # always after its previous consumption (p >= 1)
+    wrap0 = jax.lax.pcast(
+        jnp.zeros((n_micro,) + hidden.shape, hidden.dtype), vaxes,
+        to="varying",
+    )
+    loss0 = jax.lax.pcast(jnp.zeros((), jnp.float32), vaxes, to="varying")
+    perm_fwd = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+    def step(state, t):
+        carry, wrap, loss_sum = state
+        # park the arriving wrapped activation (device 0 only matters;
+        # the write is harmless elsewhere): arrival at tick t carries
+        # micro (t - p) mod m of some finished chunk
+        arr_slot = jnp.maximum(t - n_stages, 0) % n_micro
+        arrived = t >= n_stages
+        cur = jax.lax.dynamic_index_in_dim(
+            wrap, arr_slot, 0, keepdims=False
+        )
+        wrap = jax.lax.dynamic_update_index_in_dim(
+            wrap, jnp.where(arrived, carry, cur), arr_slot, 0
+        )
+        # this device's active (chunk, micro) at tick t: chunk c's sweep
+        # occupies ticks [c*m + d, c*m + d + m)
+        rel = t - stage_idx
+        c = jnp.clip(
+            jnp.where(rel >= 0, rel // n_micro, 0), 0, n_chunks - 1
+        )
+        m = rel - c * n_micro
+        valid = jnp.logical_and(rel >= 0, m < n_micro)
+        mc = jnp.clip(m, 0, n_micro - 1)
+        emb = first_fn(first_arrays, xs[mc])
+        wrapped = jax.lax.dynamic_index_in_dim(wrap, mc, 0, keepdims=False)
+        inp = jnp.where(
+            stage_idx == 0,
+            jnp.where(c == 0, emb, wrapped),
+            carry,
+        )
+        sp_c = jax.tree_util.tree_map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, c, 0, keepdims=False),
+            chunks,
+        )
+        out = sfn(sp_c, inp)
+        loss_mb = last_fn(
+            last_arrays, out, aux[mc] if aux is not None else None
+        )
+        final = jnp.logical_and(
+            jnp.logical_and(stage_idx == n_stages - 1, c == n_chunks - 1),
+            valid,
+        )
+        loss_sum = loss_sum + jnp.where(
+            final, loss_mb.astype(jnp.float32), 0.0
+        )
+        carry_next = jax.lax.ppermute(out, axis_name, perm_fwd)
+        return (carry_next, wrap, loss_sum), None
+
+    T = n_chunks * n_micro + n_stages - 1
+    (_, _, loss_sum), _ = jax.lax.scan(
+        step, (carry0, wrap0, loss0), jnp.arange(T)
+    )
+    mask = (stage_idx == n_stages - 1).astype(jnp.float32)
+    loss = jax.lax.psum(loss_sum * mask, axis_name) / n_micro
+    if data_axis is not None:
+        loss = jax.lax.pmean(loss, data_axis)
+    return loss
+
+
+def pipeline_vpp(first_fn, stage_fn, last_fn, first_params,
+                 stacked_params, last_params, x, aux=None, *,
+                 mesh: ProcessMesh, axis_name="pp", num_micro_batches=None,
+                 virtual_chunks=2, remat=False, data_axis=None,
+                 tp_axis=None, stacked_tp_dims=None, last_tp_dims=None,
+                 cache=None):
+    """Interleaved-virtual-stage schedule (see block comment above).
+
+    stacked_params leaves: [n_stages, v, lps_v, ...] — entry [d, c] holds
+    logical stage c*n_stages + d. Same contract as pipeline_program
+    otherwise; requires num_micro_batches >= n_stages (wrap conflict-
+    freedom) and returns the scalar mean loss on the autograd tape.
+    """
+    n_stages = mesh.get_dim_size(axis_name)
+    nm = num_micro_batches or n_stages
+    if nm < n_stages:
+        raise ValueError(
+            f"vpp needs num_micro_batches ({nm}) >= n_stages ({n_stages}) "
+            "so wrapped chunk sweeps do not collide with injection"
+        )
+    if not isinstance(x, Tensor):
+        x = Tensor(x)
+    if aux is not None and not isinstance(aux, Tensor):
+        aux = Tensor(aux)
+    (stacked_params, stacked_spec, first_spec, last_spec, data_spec,
+     (f_flat, f_tree), (s_flat, s_tree), (l_flat, l_tree)) = (
+        _pipeline_scaffold(first_params, stacked_params, last_params,
+                           mesh, axis_name, data_axis, tp_axis,
+                           stacked_tp_dims, last_tp_dims)
+    )
+    ckey = ("vpp", _shape_key(x, aux, first_params, stacked_params,
+                              last_params), nm, virtual_chunks, remat,
+            data_axis, tp_axis)
+    mapped = None if cache is None else cache.get(ckey)
+    if mapped is None:
+        local = functools.partial(
+            _pipeline_vpp_local, first_fn=first_fn, stage_fn=stage_fn,
+            last_fn=last_fn, axis_name=axis_name, n_micro=nm,
+            n_chunks=virtual_chunks, remat=remat, data_axis=data_axis,
+        )
+        mapped = jax.jit(jax.shard_map(
+            local, mesh=mesh.jax_mesh(),
+            in_specs=(first_spec, stacked_spec, last_spec, data_spec,
+                      data_spec if aux is not None else None),
+            out_specs=PartitionSpec(),
+        ))
+        if cache is not None:
+            cache[ckey] = mapped
+
+    nf, ns = len(f_flat), len(s_flat)
+    aux_arr = aux._data if aux is not None else None
+
+    def impl(x_arr, *param_arrays):
+        fp = jax.tree_util.tree_unflatten(f_tree, param_arrays[:nf])
+        sp = jax.tree_util.tree_unflatten(
+            s_tree, param_arrays[nf:nf + ns])
+        lp = jax.tree_util.tree_unflatten(l_tree, param_arrays[nf + ns:])
+        xs = _microbatch(x_arr, nm)
+        auxs = _microbatch(aux_arr, nm) if aux_arr is not None else None
+        return mapped(fp, sp, lp, xs, auxs)
+
+    return _dispatch_pipeline(
+        "pipeline_vpp", impl, [x] + f_flat + s_flat + l_flat,
         (x,) + tuple(f_flat) + tuple(s_flat) + tuple(l_flat),
     )
 
@@ -478,12 +742,15 @@ def _pipeline_1f1b_local(first_arrays, stage_arrays, last_arrays, xs, aux,
     buf_n = 2 * n_stages  # stash bound: ≤ 2(n-1-s)+1 in flight per stage
 
     def zeros_like_tree(t):
-        return jax.tree_util.tree_map(
-            lambda p: jax.lax.pcast(
-                jnp.zeros(p.shape, p.dtype), vaxes, to="varying"
-            ),
-            t,
-        )
+        # grad accumulators must carry each leaf's exact varying axes:
+        # tp-sharded weights are varying over tp as well as (pp, dp), and
+        # a scan carry's types must match across iterations
+        def z(p):
+            out = jnp.zeros(p.shape, p.dtype)
+            vma = tuple(getattr(jax.typeof(p), "vma", ()) or vaxes)
+            return jax.lax.pcast(out, vma, to="varying") if vma else out
+
+        return jax.tree_util.tree_map(z, t)
 
     def zeros_varying(shape, dtype):
         return jax.lax.pcast(jnp.zeros(shape, dtype), vaxes, to="varying")
@@ -582,10 +849,196 @@ def _pipeline_1f1b_local(first_arrays, stage_arrays, last_arrays, xs, aux,
     return loss, dfp, dsp, dlp
 
 
+# --------------------------------------------------------------------------
+# Zero-bubble (ZBH1-style): the backward is split into the dX stream (input
+# cotangents — the inter-stage dependency chain) and the dW stream (weight
+# gradients — off the chain), and dW(s, m) is deferred by s ticks to the
+# uniform tick t = 2(p-1) + m, exactly filling each stage's drain bubbles
+# without extending the 1F1B timeline.  ref: distributed/passes/
+# pipeline_scheduler_pass/pipeline_zero_bubble.py:38-62 — the reference
+# splits matmul_grad into separate dX/dW ops and re-schedules the W jobs;
+# here the split is two vjp applications per tick (one pulling dinp for
+# micro m_b, one pulling weight grads for the earlier micro m_w) with the
+# output cotangent stashed between them. On TPU the wall-clock win of the
+# reference's host reordering is subsumed by XLA's static schedule (module
+# docstring); this provides the schedule semantics + the memory profile
+# (weight grads deferred, cotangents stashed O(p)).
+# --------------------------------------------------------------------------
+
+
+def _pipeline_zb_local(first_arrays, stage_arrays, last_arrays, xs, aux,
+                       *, first_fn, stage_fn, last_fn, axis_name,
+                       n_micro, data_axis=None):
+    n_stages = jax.lax.psum(1, axis_name)
+    s_idx = jax.lax.axis_index(axis_name)
+    sp = jax.tree_util.tree_map(lambda p: p[0], stage_arrays)
+    vaxes = (axis_name,) + ((data_axis,) if data_axis is not None else ())
+
+    def to_varying(tree):
+        return jax.tree_util.tree_map(
+            lambda p: jax.lax.pcast(p, vaxes, to="varying"), tree
+        )
+
+    first_arrays = to_varying(first_arrays)
+    last_arrays = to_varying(last_arrays)
+    if data_axis is not None:
+        sp = jax.tree_util.tree_map(
+            lambda p: jax.lax.pcast(p, (data_axis,), to="varying"), sp
+        )
+
+    hidden = jax.eval_shape(first_fn, first_arrays, xs[0])
+    buf_n = 2 * n_stages
+
+    def zeros_like_tree(t):
+        def z(p):
+            out = jnp.zeros(p.shape, p.dtype)
+            vma = tuple(getattr(jax.typeof(p), "vma", ()) or vaxes)
+            return jax.lax.pcast(out, vma, to="varying") if vma else out
+
+        return jax.tree_util.tree_map(z, t)
+
+    def zeros_varying(shape, dtype):
+        return jax.lax.pcast(jnp.zeros(shape, dtype), vaxes, to="varying")
+
+    fwd0 = zeros_varying(hidden.shape, hidden.dtype)
+    bwd0 = zeros_varying(hidden.shape, hidden.dtype)
+    buf0 = zeros_varying((buf_n,) + hidden.shape, hidden.dtype)
+    cot0 = zeros_varying((buf_n,) + hidden.shape, hidden.dtype)
+    dsp0 = zeros_like_tree(sp)
+    dfp0 = zeros_like_tree(first_arrays)
+    dlp0 = zeros_like_tree(last_arrays)
+    loss0 = zeros_varying((), jnp.float32)
+
+    perm_fwd = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+    perm_bwd = [(j, (j - 1) % n_stages) for j in range(n_stages)]
+
+    def masked_add(acc, inc, valid):
+        return jax.tree_util.tree_map(
+            lambda a, i: a + jnp.where(valid, i, jnp.zeros_like(i)),
+            acc, inc,
+        )
+
+    def tick(state, t):
+        (fwd_c, bwd_c, buf, cot_buf, dsp, dfp, dlp, loss_sum) = state
+
+        # ---- forward micro-step: F(s, m_f) at t = s + m_f
+        m_f = t - s_idx
+        valid_f = jnp.logical_and(m_f >= 0, m_f < n_micro)
+        mfc = jnp.clip(m_f, 0, n_micro - 1)
+        emb = first_fn(first_arrays, xs[mfc])
+        inp = jnp.where(s_idx == 0, emb, fwd_c)
+        out = stage_fn(sp, inp)
+        slot_f = mfc % buf_n
+        cur = jax.lax.dynamic_index_in_dim(buf, slot_f, 0, keepdims=False)
+        buf = jax.lax.dynamic_update_index_in_dim(
+            buf, jnp.where(valid_f, inp, cur), slot_f, 0
+        )
+
+        # ---- dX micro-step: B_dx(s, m_b) at t = 2(p-1) - s + m_b
+        m_b = t - (2 * (n_stages - 1) - s_idx)
+        valid_b = jnp.logical_and(m_b >= 0, m_b < n_micro)
+        mbc = jnp.clip(m_b, 0, n_micro - 1)
+        slot_b = mbc % buf_n
+        inp_b = jax.lax.dynamic_index_in_dim(
+            buf, slot_b, 0, keepdims=False
+        )
+        out_b, pull = jax.vjp(stage_fn, sp, inp_b)
+        aux_b = aux[mbc] if aux is not None else None
+        loss_m, pull_last = jax.vjp(
+            lambda lp, h: last_fn(lp, h, aux_b), last_arrays, out_b
+        )
+        dlp_inc, dout_last = pull_last(jnp.ones_like(loss_m))
+        is_last = s_idx == n_stages - 1
+        cot_out = jnp.where(is_last, dout_last.astype(hidden.dtype), bwd_c)
+        _, dinp = pull(cot_out)
+        # stash the output cotangent for this micro's deferred dW tick
+        cur_c = jax.lax.dynamic_index_in_dim(
+            cot_buf, slot_b, 0, keepdims=False
+        )
+        cot_buf = jax.lax.dynamic_update_index_in_dim(
+            cot_buf, jnp.where(valid_b, cot_out, cur_c), slot_b, 0
+        )
+        # stage-0 edge: push the input cotangent through first_fn
+        _, pull_first = jax.vjp(first_fn, first_arrays, xs[mbc])
+        dfp_inc = pull_first(dinp)[0]
+        dlp = masked_add(dlp, dlp_inc,
+                         jnp.logical_and(valid_b, is_last))
+        dfp = masked_add(dfp, dfp_inc,
+                         jnp.logical_and(valid_b, s_idx == 0))
+        loss_sum = loss_sum + jnp.where(
+            jnp.logical_and(valid_b, is_last),
+            loss_m.astype(jnp.float32), 0.0,
+        )
+
+        # ---- dW micro-step: B_dw(s, m_w) at the uniform tick
+        #      t = 2(p-1) + m_w  (deferred by s from its dX tick)
+        m_w = t - 2 * (n_stages - 1)
+        valid_w = jnp.logical_and(m_w >= 0, m_w < n_micro)
+        mwc = jnp.clip(m_w, 0, n_micro - 1)
+        slot_w = mwc % buf_n
+        inp_w = jax.lax.dynamic_index_in_dim(
+            buf, slot_w, 0, keepdims=False
+        )
+        cot_w = jax.lax.dynamic_index_in_dim(
+            cot_buf, slot_w, 0, keepdims=False
+        )
+        _, pull_w = jax.vjp(stage_fn, sp, inp_w)
+        dsp_inc, _ = pull_w(cot_w)
+        dsp = masked_add(dsp, dsp_inc, valid_w)
+
+        fwd_next = jax.lax.ppermute(out, axis_name, perm_fwd)
+        bwd_next = jax.lax.ppermute(dinp, axis_name, perm_bwd)
+        return (fwd_next, bwd_next, buf, cot_buf, dsp, dfp, dlp,
+                loss_sum), None
+
+    total = n_micro + 2 * (n_stages - 1)
+    state0 = (fwd0, bwd0, buf0, cot0, dsp0, dfp0, dlp0, loss0)
+    (_, _, _, _, dsp, dfp, dlp, loss_sum), _ = jax.lax.scan(
+        tick, state0, jnp.arange(total)
+    )
+
+    inv = jnp.float32(1.0 / n_micro)
+    mask = (s_idx == n_stages - 1).astype(jnp.float32)
+    loss = jax.lax.psum(loss_sum * mask, axis_name) * inv
+    dfp = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g * inv.astype(g.dtype), axis_name), dfp)
+    dlp = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g * inv.astype(g.dtype), axis_name), dlp)
+    dsp = jax.tree_util.tree_map(
+        lambda g: (g * inv.astype(g.dtype))[None], dsp)
+    if data_axis is not None:
+        loss = jax.lax.pmean(loss, data_axis)
+        pm = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda g: jax.lax.pmean(g, data_axis), t)
+        dfp, dsp, dlp = pm(dfp), pm(dsp), pm(dlp)
+    return loss, dfp, dsp, dlp
+
+
+def pipeline_zero_bubble(first_fn, stage_fn, last_fn, first_params,
+                         stacked_params, last_params, x, aux=None, *,
+                         mesh: ProcessMesh, axis_name="pp",
+                         num_micro_batches=None, data_axis=None,
+                         tp_axis=None, stacked_tp_dims=None,
+                         last_tp_dims=None, cache=None):
+    """ZBH1-style schedule (block comment above): same contract as
+    pipeline_1f1b; weight-gradient (dW) work is deferred off the dX
+    dependency chain into the drain bubbles."""
+    return pipeline_1f1b(
+        first_fn, stage_fn, last_fn, first_params, stacked_params,
+        last_params, x, aux, mesh=mesh, axis_name=axis_name,
+        num_micro_batches=num_micro_batches, data_axis=data_axis,
+        tp_axis=tp_axis, stacked_tp_dims=stacked_tp_dims,
+        last_tp_dims=last_tp_dims, cache=cache,
+        _local_fn=_pipeline_zb_local, _tag="zb",
+    )
+
+
 def pipeline_1f1b(first_fn, stage_fn, last_fn, first_params,
                   stacked_params, last_params, x, aux=None, *,
                   mesh: ProcessMesh, axis_name="pp",
-                  num_micro_batches=None, data_axis=None, cache=None):
+                  num_micro_batches=None, data_axis=None, tp_axis=None,
+                  stacked_tp_dims=None, last_tp_dims=None, cache=None,
+                  _local_fn=None, _tag="1f1b"):
     """1F1B-scheduled pipelined loss (see module docstring). Same contract
     as pipeline_program; gradients for first/stacked/last params are
     computed inline during the forward scan and surfaced to the autograd
@@ -598,38 +1051,39 @@ def pipeline_1f1b(first_fn, stage_fn, last_fn, first_params,
         x = Tensor(x)
     if aux is not None and not isinstance(aux, Tensor):
         aux = Tensor(aux)
-    (stacked_params, stacked_spec, data_spec,
+    (stacked_params, stacked_spec, first_spec, last_spec, data_spec,
      (f_flat, f_tree), (s_flat, s_tree), (l_flat, l_tree)) = (
         _pipeline_scaffold(first_params, stacked_params, last_params,
-                           mesh, axis_name, data_axis)
+                           mesh, axis_name, data_axis, tp_axis,
+                           stacked_tp_dims, last_tp_dims)
     )
     nf, ns = len(f_flat), len(s_flat)
     x_arr = x._data
     aux_arr = aux._data if aux is not None else None
 
-    ckey = ("1f1b", _shape_key(x, aux, first_params, stacked_params,
-                               last_params), nm, data_axis)
+    ckey = (_tag, _shape_key(x, aux, first_params, stacked_params,
+                             last_params), nm, data_axis, tp_axis)
     mapped = None if cache is None else cache.get(ckey)
     if mapped is None:
         local = functools.partial(
-            _pipeline_1f1b_local, first_fn=first_fn, stage_fn=stage_fn,
-            last_fn=last_fn, axis_name=axis_name, n_micro=nm,
-            data_axis=data_axis,
+            _local_fn or _pipeline_1f1b_local, first_fn=first_fn,
+            stage_fn=stage_fn, last_fn=last_fn, axis_name=axis_name,
+            n_micro=nm, data_axis=data_axis,
         )
         mapped = jax.jit(jax.shard_map(
             local, mesh=mesh.jax_mesh(),
             in_specs=(
-                _edge_spec(first_params),
+                first_spec,
                 stacked_spec,
-                _edge_spec(last_params),
+                last_spec,
                 data_spec,
                 data_spec if aux_arr is not None else None,
             ),
             out_specs=(
                 PartitionSpec(),
-                _edge_spec(first_params),
+                first_spec,
                 stacked_spec,
-                _edge_spec(last_params),
+                last_spec,
             ),
         ))
         if cache is not None:
