@@ -2,19 +2,28 @@
 io/dataloader/dataloader_iter.py multiprocess workers + shared-memory
 transport; C++ core imperative/data_loader.cc).
 
-TPU-first host pipeline: the reference's fork-per-worker + shm design
-exists to parallelize CPU tensor decoding for GPU feeding. Feeding a TPU
-from Python, the bottleneck is batch assembly + H2D, so the pipeline is:
-worker THREADS (numpy collate releases the GIL for big copies) pulling
-index batches, a bounded prefetch queue, and asynchronous device_put of
-the next batch while the current one trains (the async-H2D double
-buffering the reference gets from its DataFeed). num_workers=0 degrades
-to synchronous iteration.
+TPU-first host pipeline, two worker transports:
+
+* THREADS (default): numpy collate releases the GIL for big copies;
+  worker threads pull index batches into a bounded prefetch queue with
+  async device_put double buffering. Right when item loading is IO- or
+  copy-bound.
+* PROCESSES (``use_shared_memory=True``): fork-per-worker with
+  pickle-free numpy transport over ``multiprocessing.shared_memory``
+  (the reference's design: dataloader_iter.py:368 forked workers,
+  worker.py:293 loop, shm tensor transport). Right when the per-item
+  transform is Python-compute-bound (GIL-bound under threads). Workers
+  run dataset code only — never JAX — so forking under an initialized
+  JAX parent is safe.
+
+num_workers=0 degrades to synchronous iteration.
 """
 from __future__ import annotations
 
+import multiprocessing as _mp
 import queue
 import threading
+import traceback
 
 import numpy as np
 
@@ -160,10 +169,194 @@ class _Prefetcher:
             pass
 
 
+# -- process workers + shared-memory transport ------------------------------
+
+
+def _shm_pack(tree):
+    """numpy pytree -> (meta, shm handles): arrays are copied into
+    SharedMemory blocks and described by (name, shape, dtype) — the
+    pickle-free transport of the reference's shm tensors
+    (io/dataloader/worker.py:418 _convert_to_tensor_list analogue)."""
+    from multiprocessing import shared_memory
+
+    shms = []
+
+    def pack(v):
+        if isinstance(v, Tensor):
+            v = np.asarray(v._data)
+        if isinstance(v, np.ndarray):
+            shm = shared_memory.SharedMemory(
+                create=True, size=max(1, v.nbytes)
+            )
+            dst = np.ndarray(v.shape, v.dtype, buffer=shm.buf)
+            dst[...] = v
+            shms.append(shm)
+            return ("__shm__", shm.name, v.shape, str(v.dtype))
+        if isinstance(v, dict):
+            return {k: pack(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return type(v)(pack(x) for x in v)
+        return v
+
+    return pack(tree), shms
+
+
+def _shm_unpack(meta):
+    """Rebuild the pytree from shm descriptors; copies out and unlinks."""
+    from multiprocessing import shared_memory
+
+    def unpack(v):
+        if isinstance(v, tuple) and len(v) == 4 and v[0] == "__shm__":
+            _, name, shape, dtype = v
+            shm = shared_memory.SharedMemory(name=name)
+            try:
+                arr = np.array(
+                    np.ndarray(shape, dtype, buffer=shm.buf), copy=True
+                )
+            finally:
+                shm.close()
+                shm.unlink()
+            return arr
+        if isinstance(v, dict):
+            return {k: unpack(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [unpack(x) for x in v]
+        if isinstance(v, tuple):
+            return tuple(unpack(x) for x in v)
+        return v
+
+    return unpack(meta)
+
+
+def _mp_worker_loop(dataset, collate_fn, index_q, result_q, worker_id,
+                    worker_init_fn):
+    """Worker process body (ref io/dataloader/worker.py:293 _worker_loop):
+    pull index batches, load + collate to numpy, ship via shared memory.
+    Runs dataset code only — no JAX."""
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        while True:
+            job = index_q.get()
+            if job is None:
+                result_q.put((None, "__done__", None))
+                return
+            bidx, indices = job
+            try:
+                batch = collate_fn([dataset[i] for i in indices])
+                meta, shms = _shm_pack(batch)
+                result_q.put((bidx, "__ok__", meta))
+                for s in shms:
+                    s.close()  # consumer unlinks
+            except Exception:
+                result_q.put((None, "__err__", traceback.format_exc()))
+                return
+    except KeyboardInterrupt:
+        pass
+
+
+class _MPLoaderIter:
+    """In-order multiprocess iteration (ref dataloader_iter.py:368
+    _DataLoaderIterMultiProcess: fork workers, per-batch reordering by
+    _rcvd_idx, error propagation with worker traceback)."""
+
+    def __init__(self, loader):
+        ctx = _mp.get_context("fork")
+        self._n = loader.num_workers
+        self._index_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        self._batches = list(enumerate(loader.batch_sampler))
+        self._total = len(self._batches)
+        # bounded prefetch (the reference's outstanding-batch window,
+        # dataloader_iter.py _outstanding_capacity): only this many index
+        # batches are in flight, so /dev/shm holds O(depth) batches, not
+        # the whole epoch
+        self._depth = max(
+            self._n, loader.prefetch_factor * self._n
+        )
+        self._fed = 0
+        self._sent_stop = False
+        self._procs = [
+            ctx.Process(
+                target=_mp_worker_loop,
+                args=(loader.dataset, loader.collate_fn, self._index_q,
+                      self._result_q, w, loader.worker_init_fn),
+                daemon=True,
+            )
+            for w in range(self._n)
+        ]
+        for p in self._procs:
+            p.start()
+
+    def _feed(self, served):
+        while (self._fed < self._total
+               and self._fed - served < self._depth):
+            self._index_q.put(self._batches[self._fed])
+            self._fed += 1
+        if self._fed >= self._total and not self._sent_stop:
+            for _ in range(self._n):
+                self._index_q.put(None)
+            self._sent_stop = True
+
+    def __iter__(self):
+        done, served, want, pending = 0, 0, 0, {}
+        try:
+            self._feed(0)
+            while served < self._total:
+                try:
+                    bidx, tag, payload = self._result_q.get(timeout=5.0)
+                except queue.Empty:
+                    # liveness: a worker killed by the OS (OOM/segfault)
+                    # posts nothing; if nobody is left and the queue
+                    # stayed empty through the timeout, nothing will come
+                    if not any(p.is_alive() for p in self._procs):
+                        raise RuntimeError(
+                            "DataLoader workers died before producing "
+                            "all batches (killed by the OS?)"
+                        )
+                    continue
+                if tag == "__done__":
+                    done += 1
+                    if done == self._n and served < self._total:
+                        raise RuntimeError(
+                            "DataLoader workers exited before producing "
+                            "all batches"
+                        )
+                    continue
+                if tag == "__err__":
+                    raise RuntimeError(
+                        f"DataLoader worker failed:\n{payload}"
+                    )
+                pending[bidx] = payload
+                while want in pending:
+                    yield _to_device(_shm_unpack(pending.pop(want)))
+                    want += 1
+                    served += 1
+                    self._feed(served)
+        finally:
+            self.shutdown()
+
+    def shutdown(self):
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(timeout=5)
+        # unlink any unconsumed shm blocks
+        try:
+            while True:
+                _, tag, payload = self._result_q.get_nowait()
+                if tag == "__ok__":
+                    _shm_unpack(payload)
+        except queue.Empty:
+            pass
+
+
 class DataLoader:
     """ref: io/reader.py:262. Supported: map + iterable datasets, custom
     sampler/batch_sampler/collate_fn, shuffle, drop_last, num_workers
-    (threads), prefetch_factor."""
+    (threads by default, forked processes with shared-memory transport
+    when use_shared_memory=True), prefetch_factor, worker_init_fn."""
 
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -176,7 +369,14 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = max(0, int(num_workers))
         self.prefetch_factor = max(1, int(prefetch_factor))
+        self.use_shared_memory = bool(use_shared_memory)
+        self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self.use_shared_memory and self._iterable_mode:
+            raise ValueError(
+                "use_shared_memory (process workers) requires a map-style "
+                "dataset; IterableDataset pulls are sequential"
+            )
 
         if self._iterable_mode:
             if batch_sampler is not None or shuffle:
@@ -230,6 +430,10 @@ class DataLoader:
         if self.num_workers == 0:
             for batch in self._produce():
                 yield _to_device(self.collate_fn(batch))
+            return
+
+        if self.use_shared_memory and not self._iterable_mode:
+            yield from _MPLoaderIter(self)
             return
 
         def job_stream():

@@ -1,0 +1,126 @@
+"""Multiprocess DataLoader workers + shared-memory transport.
+
+ref: io/dataloader/dataloader_iter.py:368 (_DataLoaderIterMultiProcess),
+worker.py:293 (_worker_loop), shm tensor transport. Checks: workers are
+real processes, batches arrive complete/in-order/bit-exact, worker
+exceptions propagate with traceback, worker_init_fn runs per worker.
+(True multi-core scaling cannot be asserted on this 1-core CI host; the
+transport + lifecycle contracts are what these tests pin.)
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+
+
+class PidDataset(Dataset):
+    """Each item records the producing process id."""
+
+    def __init__(self, n=32):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return {
+            "x": np.full((4,), i, dtype="float32"),
+            "pid": np.asarray([os.getpid()], dtype="int64"),
+        }
+
+
+class TransformDataset(Dataset):
+    """Python-compute-bound transform (the GIL-bound case process
+    workers exist for)."""
+
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        acc = 0.0
+        for k in range(200):
+            acc += (i * 31 + k) % 7
+        return np.asarray([i, acc], dtype="float32")
+
+
+class BoomDataset(Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return np.zeros((2,), "float32")
+
+
+class TestMPDataLoader:
+    def test_batches_in_order_and_exact(self):
+        dl = DataLoader(
+            PidDataset(32), batch_size=4, num_workers=2,
+            use_shared_memory=True,
+        )
+        seen = []
+        for batch in dl:
+            seen.append(batch["x"].numpy())
+        got = np.concatenate([b[:, 0] for b in seen])
+        np.testing.assert_array_equal(got, np.arange(32, dtype="float32"))
+
+    def test_workers_are_processes(self):
+        dl = DataLoader(
+            PidDataset(16), batch_size=4, num_workers=2,
+            use_shared_memory=True,
+        )
+        pids = set()
+        for batch in dl:
+            pids.update(int(p) for p in batch["pid"].numpy().ravel())
+        assert os.getpid() not in pids, "items were produced in-process"
+
+    def test_compute_bound_transform_correct(self):
+        dl = DataLoader(
+            TransformDataset(), batch_size=4, num_workers=2,
+            use_shared_memory=True,
+        )
+        rows = np.concatenate([b.numpy() for b in dl])
+        for i, acc in rows:
+            want = sum((int(i) * 31 + k) % 7 for k in range(200))
+            assert acc == want
+
+    def test_worker_exception_propagates(self):
+        dl = DataLoader(
+            BoomDataset(), batch_size=2, num_workers=2,
+            use_shared_memory=True,
+        )
+        with pytest.raises(RuntimeError, match="boom at 5"):
+            list(dl)
+
+    def test_worker_init_fn_runs_in_worker(self, tmp_path):
+        marker = str(tmp_path / "w{}.txt")
+
+        def init(worker_id):
+            with open(marker.format(worker_id), "w") as f:
+                f.write(str(os.getpid()))
+
+        dl = DataLoader(
+            PidDataset(8), batch_size=4, num_workers=2,
+            use_shared_memory=True, worker_init_fn=init,
+        )
+        list(dl)
+        pids = set()
+        for w in range(2):
+            with open(marker.format(w)) as f:
+                pids.add(int(f.read()))
+        assert os.getpid() not in pids
+
+    def test_shared_memory_rejects_iterable(self):
+        from paddle_tpu.io import IterableDataset
+
+        class It(IterableDataset):
+            def __iter__(self):
+                yield np.zeros((1,), "float32")
+
+        with pytest.raises(ValueError, match="map-style"):
+            DataLoader(It(), batch_size=1, num_workers=1,
+                       use_shared_memory=True)
