@@ -9,6 +9,30 @@ the parallelizer, Pallas the escape hatch for fused attention/normalization.
 """
 from __future__ import annotations
 
+import os as _os
+
+# Multi-process bring-up MUST precede any XLA backend touch (jax raises
+# otherwise), so when the launcher's env contract is present the
+# coordination-service rendezvous happens here, at import — the analogue
+# of the reference doing TCPStore + ncclCommInitRank inside
+# init_parallel_env (distributed/parallel.py:978), shifted to import time
+# because jax owns backend initialization. Opt out with
+# PADDLE_DISABLE_AUTO_DIST=1.
+if (
+    _os.environ.get("PADDLE_MASTER")
+    and int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1
+    and _os.environ.get("PADDLE_DISABLE_AUTO_DIST") != "1"
+    and not _os.environ.get("PADDLE_TPU_DIST_INITED")
+):
+    import jax as _jax
+
+    _jax.distributed.initialize(
+        coordinator_address=_os.environ["PADDLE_MASTER"],
+        num_processes=int(_os.environ["PADDLE_TRAINERS_NUM"]),
+        process_id=int(_os.environ.get("PADDLE_TRAINER_ID", "0")),
+    )
+    _os.environ["PADDLE_TPU_DIST_INITED"] = "1"
+
 from .core import autograd as _autograd_mod
 from .core import dtype as _dtype_mod
 from .core.autograd import enable_grad, is_grad_enabled, no_grad, set_grad_enabled
@@ -50,6 +74,7 @@ _tensor_patch.patch()
 from .autograd import grad  # noqa: E402  (needs patched Tensor)
 from . import amp  # noqa: E402
 from . import audio  # noqa: E402
+from . import text  # noqa: E402
 from . import autograd  # noqa: E402
 from . import framework  # noqa: E402
 from . import device  # noqa: E402

@@ -54,27 +54,39 @@ def _parse(argv):
                         "which incarnation they are)")
     p.add_argument("--restart_interval", type=float, default=1.0,
                    help="seconds between elastic relaunches")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic manager v2: store-based membership with "
+                        "rank remap — on any node's failure the surviving "
+                        "nodes re-rendezvous, get new contiguous ranks "
+                        "(scale-down) and relaunch; requires --master")
+    p.add_argument("--elastic_grace", type=float, default=5.0,
+                   help="seconds the master waits for members to register "
+                        "before sealing a (possibly smaller) epoch")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
-def _worker_env(args, local_rank):
+def _worker_env(args, local_rank, node_rank=None, nnodes=None,
+                master=None):
     env = dict(os.environ)
-    world = args.nnodes * args.nproc_per_node
-    rank = args.rank * args.nproc_per_node + local_rank
+    node_rank = args.rank if node_rank is None else node_rank
+    nnodes = args.nnodes if nnodes is None else nnodes
+    master = args.master if master is None else master
+    world = nnodes * args.nproc_per_node
+    rank = node_rank * args.nproc_per_node + local_rank
     env.update({
         "PADDLE_TRAINER_ID": str(rank),
         "PADDLE_TRAINERS_NUM": str(world),
         "PADDLE_LOCAL_RANK": str(local_rank),
-        "PADDLE_NNODES": str(args.nnodes),
+        "PADDLE_NNODES": str(nnodes),
     })
-    if args.master:
-        env["PADDLE_MASTER"] = args.master
+    if master:
+        env["PADDLE_MASTER"] = master
         # jax.distributed.initialize reads these directly
-        env.setdefault("JAX_COORDINATOR_ADDRESS", args.master)
-        env.setdefault("JAX_NUM_PROCESSES", str(world))
-        env.setdefault("JAX_PROCESS_ID", str(rank))
+        env["JAX_COORDINATOR_ADDRESS"] = master
+        env["JAX_NUM_PROCESSES"] = str(world)
+        env["JAX_PROCESS_ID"] = str(rank)
     if args.devices:
         env["TPU_VISIBLE_DEVICES"] = args.devices
     return env
@@ -93,6 +105,8 @@ def launch(argv=None):
     (distributed/checkpoint.py), which is the reference's
     train-resume contract."""
     args = _parse(argv if argv is not None else sys.argv[1:])
+    if args.elastic:
+        return _elastic_launch(args)
     restarts = 0
     while True:
         code = _run_pod(args, restarts)
@@ -107,18 +121,97 @@ def launch(argv=None):
         time.sleep(args.restart_interval)
 
 
-def _run_pod(args, restart_count=0):
+_RESTART_CODE = -999  # internal: pod stopped because the epoch moved on
+
+
+def _elastic_launch(args):
+    """Elastic manager v2 (ref fleet/elastic/manager.py:125): membership
+    epochs over the TCPStore. Per epoch every surviving node registers;
+    the master seals the member list after a grace period (all nnodes
+    present ends the wait early), assigns NEW CONTIGUOUS RANKS (rank
+    remap — a lost node shrinks the world), and every node launches its
+    pod against a fresh coordinator port. Any node whose pod fails bumps
+    the epoch; every supervision loop polls it and re-rendezvouses.
+    Workers see the usual env contract plus PADDLE_RESTART_COUNT and
+    resume from their checkpoints."""
+    import json as _json
+
+    from ..store import TCPStore
+
+    if not args.master:
+        raise SystemExit("--elastic requires --master host:port")
+    host, port = args.master.rsplit(":", 1)
+    store = TCPStore(
+        host, int(port) + 1, is_master=args.rank == 0, timeout=120.0
+    )
+    epoch, restarts = 0, 0
+    while True:
+        epoch = max(
+            epoch, int(store.get("current_epoch", wait=False) or 0)
+        )
+        store.set(f"epoch/{epoch}/node/{args.rank}", "alive")
+        if args.rank == 0:
+            deadline = time.time() + args.elastic_grace
+            while time.time() < deadline:
+                n = len(store.list_keys(f"epoch/{epoch}/node/"))
+                if n >= args.nnodes:
+                    break
+                time.sleep(0.1)
+            members = sorted(
+                int(k.rsplit("/", 1)[1])
+                for k in store.list_keys(f"epoch/{epoch}/node/")
+            )
+            plan = {
+                "ranks": {str(nid): i for i, nid in enumerate(members)},
+                "nnodes": len(members),
+                "coord_port": int(port) + 2 + epoch,
+            }
+            store.set(f"epoch/{epoch}/plan", _json.dumps(plan))
+            print(f"elastic: epoch {epoch} sealed with nodes {members}",
+                  file=sys.stderr)
+        plan = _json.loads(store.get(f"epoch/{epoch}/plan"))
+        my_rank = plan["ranks"].get(str(args.rank))
+        if my_rank is None:
+            print(f"elastic: node {args.rank} not in epoch {epoch}; "
+                  "exiting", file=sys.stderr)
+            return 0
+
+        def epoch_moved(e=epoch):
+            return int(store.get("current_epoch", wait=False) or 0) > e
+
+        code = _run_pod(
+            args, restarts, node_rank=my_rank, nnodes=plan["nnodes"],
+            master=f"{host}:{plan['coord_port']}", stop_check=epoch_moved,
+        )
+        if code == 0:
+            return 0
+        if code != _RESTART_CODE:
+            # our pod failed: tell the others and count the restart
+            restarts += 1
+            store.set("current_epoch", str(epoch + 1))
+            if restarts > args.max_restarts:
+                print(f"elastic: max_restarts ({args.max_restarts}) "
+                      "exhausted", file=sys.stderr)
+                return code
+        epoch += 1
+        time.sleep(args.restart_interval)
+
+
+def _run_pod(args, restart_count=0, node_rank=None, nnodes=None,
+             master=None, stop_check=None):
     os.makedirs(args.log_dir, exist_ok=True)
 
     procs = []
     for local_rank in range(args.nproc_per_node):
-        rank = args.rank * args.nproc_per_node + local_rank
+        nr = args.rank if node_rank is None else node_rank
+        rank = nr * args.nproc_per_node + local_rank
         suffix = f".r{restart_count}" if restart_count else ""
         log_path = os.path.join(args.log_dir, f"workerlog.{rank}{suffix}")
         log_f = open(log_path, "w")
         cmd = [sys.executable, "-u", args.training_script,
                *args.training_script_args]
-        env = _worker_env(args, local_rank)
+        env = _worker_env(args, local_rank, node_rank=node_rank,
+                          nnodes=nnodes, master=master)
         env["PADDLE_RESTART_COUNT"] = str(restart_count)
         proc = subprocess.Popen(
             cmd, env=env,
@@ -133,6 +226,11 @@ def _run_pod(args, restart_count=0):
     exit_code = 0
     try:
         while procs:
+            if stop_check is not None and stop_check():
+                print("elastic: epoch moved on — stopping local pod",
+                      file=sys.stderr)
+                _terminate(procs)
+                return _RESTART_CODE
             alive = []
             for proc, log_f, log_path in procs:
                 ret = proc.poll()

@@ -53,18 +53,28 @@ class ParallelEnv:
 
 def init_parallel_env():
     """Bring up the parallel context (ref parallel.py:978). Multi-host
-    initialization goes through jax.distributed (coordination service =
-    the TCPStore analogue); single-host is a no-op beyond building the
-    default device mesh."""
+    initialization goes through jax.distributed (coordination service);
+    the env contract (PADDLE_MASTER / PADDLE_TRAINERS_NUM /
+    PADDLE_TRAINER_ID, set by the launcher) maps onto its
+    coordinator_address / num_processes / process_id — the reference's
+    TCPStore + ncclCommInitRank rendezvous collapsed into one call.
+    Single-host is a no-op beyond building the default device mesh."""
     global _parallel_env
     if _parallel_env is None:
         coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
             "MASTER_ADDR"
         )
-        if coord and int(os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1:
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        if (coord and world > 1
+                and not os.environ.get("PADDLE_TPU_DIST_INITED")):
             import jax
 
-            jax.distributed.initialize()
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=world,
+                process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+            )
+            os.environ["PADDLE_TPU_DIST_INITED"] = "1"
         _parallel_env = ParallelEnv()
     return _parallel_env
 

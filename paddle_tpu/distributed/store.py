@@ -1,0 +1,220 @@
+"""TCPStore: the coordination key-value store.
+
+ref: phi/core/distributed/store/tcp_store.h:121 (client/server KV with
+blocking wait + timeout, used to exchange ncclUniqueId and for barriers)
+and python `paddle.distributed` Store bindings. On TPU the jax
+coordination service covers in-band bootstrap; this store serves the
+OUT-of-band uses the reference has beyond bootstrap: elastic membership
+(fleet/elastic/manager.py watches a store), rendezvous across pod
+incarnations, and user-level barriers.
+
+Wire format: length-prefixed JSON frames {op, key, value(b64)} over a
+localhost/DCN TCP socket — no pickle (untrusted peers must not gain code
+execution, unlike the reference's raw struct protocol which has the same
+property).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import socketserver
+import threading
+import time
+
+__all__ = ["TCPStore"]
+
+
+def _send_frame(sock, obj):
+    payload = json.dumps(obj).encode()
+    sock.sendall(len(payload).to_bytes(4, "big") + payload)
+
+
+def _recv_frame(sock):
+    head = b""
+    while len(head) < 4:
+        chunk = sock.recv(4 - len(head))
+        if not chunk:
+            return None
+        head += chunk
+    n = int.from_bytes(head, "big")
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return json.loads(buf.decode())
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        store = self.server.kv_owner
+        while True:
+            req = _recv_frame(self.request)
+            if req is None:
+                return
+            op = req["op"]
+            key = req.get("key", "")
+            with store._cond:
+                if op == "set":
+                    store._kv[key] = req["value"]
+                    store._cond.notify_all()
+                    _send_frame(self.request, {"ok": True})
+                elif op == "get":
+                    _send_frame(
+                        self.request,
+                        {"ok": key in store._kv,
+                         "value": store._kv.get(key)},
+                    )
+                elif op == "add":
+                    cur = int(store._kv.get(key, "0"))
+                    cur += int(req["value"])
+                    store._kv[key] = str(cur)
+                    store._cond.notify_all()
+                    _send_frame(self.request, {"ok": True, "value": cur})
+                elif op == "delete":
+                    existed = store._kv.pop(key, None) is not None
+                    store._cond.notify_all()
+                    _send_frame(self.request, {"ok": existed})
+                elif op == "list":
+                    pref = req.get("value") or ""
+                    _send_frame(
+                        self.request,
+                        {"ok": True,
+                         "keys": [k for k in store._kv if
+                                  k.startswith(pref)]},
+                    )
+                else:
+                    _send_frame(self.request,
+                                {"ok": False, "error": f"bad op {op}"})
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class TCPStore:
+    """Client (and, on the master, server) of the KV store.
+
+    TCPStore(host, port, is_master=False, timeout=30): the master starts
+    an in-process server thread; every role gets a client connection.
+    API follows the reference store: set/get/wait/add/delete_key, plus
+    list_keys for membership scans.
+    """
+
+    def __init__(self, host, port, is_master=False, timeout=30.0,
+                 world_size=None):
+        self.timeout = float(timeout)
+        self._kv = {}
+        self._cond = threading.Condition()
+        self._lock = threading.Lock()  # serializes the client socket
+        self._server = None
+        if is_master:
+            self._server = _Server((host, port), _Handler)
+            self._server.kv_owner = self
+            t = threading.Thread(
+                target=self._server.serve_forever, daemon=True
+            )
+            t.start()
+        self._addr = (host, port)
+        self._sock = self._connect()
+
+    def _connect(self):
+        deadline = time.time() + self.timeout
+        last = None
+        while time.time() < deadline:
+            try:
+                s = socket.create_connection(self._addr, timeout=5)
+                s.settimeout(self.timeout)
+                return s
+            except OSError as e:
+                last = e
+                time.sleep(0.1)
+        raise TimeoutError(
+            f"cannot reach TCPStore at {self._addr}: {last}"
+        )
+
+    def _rpc(self, op, key="", value=None):
+        with self._lock:
+            _send_frame(self._sock, {"op": op, "key": key, "value": value})
+            resp = _recv_frame(self._sock)
+        if resp is None:
+            raise ConnectionError("TCPStore server closed the connection")
+        return resp
+
+    # -- reference Store API ----------------------------------------------
+    def set(self, key: str, value):
+        if isinstance(value, bytes):
+            value = base64.b64encode(value).decode()
+            key_t, stale = "b:" + key, "s:" + key
+        else:
+            value = str(value)
+            key_t, stale = "s:" + key, "b:" + key
+        # an overwrite that changes str<->bytes must not leave the
+        # superseded typed entry behind (get() probes "s:" first)
+        self._rpc("delete", stale)
+        self._rpc("set", key_t, value)
+
+    def get(self, key: str, wait=True):
+        """Blocking get (the reference's wait-then-get contract)."""
+        deadline = time.time() + self.timeout
+        while True:
+            for kt in ("s:" + key, "b:" + key):
+                resp = self._rpc("get", kt)
+                if resp.get("ok"):
+                    v = resp["value"]
+                    if kt.startswith("b:"):
+                        return base64.b64decode(v)
+                    return v
+            if not wait:
+                return None
+            if time.time() > deadline:
+                raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+            time.sleep(0.05)
+
+    def wait(self, keys, timeout=None):
+        deadline = time.time() + (timeout or self.timeout)
+        for k in keys if isinstance(keys, (list, tuple)) else [keys]:
+            while self.get(k, wait=False) is None:
+                if time.time() > deadline:
+                    raise TimeoutError(f"TCPStore.wait({k!r}) timed out")
+                time.sleep(0.05)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return int(self._rpc("add", "s:" + key, str(amount))["value"])
+
+    def delete_key(self, key: str) -> bool:
+        ok = False
+        for kt in ("s:" + key, "b:" + key):
+            ok = self._rpc("delete", kt)["ok"] or ok
+        return ok
+
+    def list_keys(self, prefix: str = ""):
+        keys = self._rpc("list", value="s:" + prefix)["keys"]
+        keys += self._rpc("list", value="b:" + prefix)["keys"]
+        return sorted(k[2:] for k in keys)
+
+    def barrier(self, name: str, world_size: int, timeout=None):
+        """Counter barrier (the reference implements barriers over the
+        store the same way: add + wait for the full count)."""
+        n = self.add(f"__barrier/{name}", 1)
+        deadline = time.time() + (timeout or self.timeout)
+        while n < world_size:
+            n = int(self.get(f"__barrier/{name}") or 0)
+            if n >= world_size:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"barrier {name!r}: {n}/{world_size} arrived"
+                )
+            time.sleep(0.05)
+
+    def close(self):
+        try:
+            self._sock.close()
+        finally:
+            if self._server is not None:
+                self._server.shutdown()
+                self._server.server_close()
