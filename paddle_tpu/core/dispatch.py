@@ -29,6 +29,9 @@ from .tensor import Tensor
 _amp_cast_hook: Callable | None = None
 # Registered by paddle_tpu.distributed; routes DistTensor inputs.
 _dist_dispatch_hook: Callable | None = None
+# Installed by jit.graph_break's segment scope: records ops into a lazy
+# compiled segment instead of executing them (SOT-fallback mode).
+_segment_hook: Callable | None = None
 
 
 def set_amp_hook(fn):
@@ -108,6 +111,9 @@ def _check_nan_inf(name, arrays):
 def call(op_name: str, impl: Callable, args: tuple, attrs: dict[str, Any]):
     """Dispatch one op eagerly. `args` may contain Tensors, lists of Tensors,
     and None; `attrs` are static python values closed over the impl."""
+    if _segment_hook is not None:
+        return _segment_hook(op_name, impl, args, attrs)
+
     if _amp_cast_hook is not None:
         args = _amp_cast_hook(op_name, args)
 

@@ -21,6 +21,11 @@ import jax.numpy as jnp
 from . import dtype as dtype_mod
 from .device import Place, current_place
 
+# Installed by jit.graph_break while a lazy segment is live: called before
+# any concrete read of a Tensor payload, flushing the pending compiled
+# segment (the graph-break trigger point).
+_lazy_flush_hook = None
+
 
 def _coerce_array(data, dtype=None):
     if isinstance(data, Tensor):
@@ -138,6 +143,8 @@ class Tensor:
         return np.asarray(self._local_or_global_data())
 
     def _local_or_global_data(self):
+        if _lazy_flush_hook is not None:
+            _lazy_flush_hook(self)  # graph-break segment: concretize
         if self._dist_meta is not None:
             from ..distributed import dist_tensor
 

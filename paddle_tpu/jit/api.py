@@ -314,11 +314,20 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     """
     def _wrap(obj):
         if isinstance(obj, Layer):
-            sf = StaticFunction(obj.forward, layer=obj)
+            if full_graph:
+                sf = StaticFunction(obj.forward, layer=obj)
+            else:
+                from .graph_break import GraphBreakFunction
+
+                sf = GraphBreakFunction(obj.forward, layer=obj)
             obj.forward = sf
             return obj
         if obj in _NOT_TO_STATIC:
             return obj
+        if not full_graph:
+            from .graph_break import GraphBreakFunction
+
+            return GraphBreakFunction(obj)
         return StaticFunction(obj)
 
     if function is not None:

@@ -1,0 +1,162 @@
+"""Graph-break fallback for to_static(full_graph=False).
+
+ref: the reference's SOT contract (jit/sot/opcode_translator — symbolic
+trace with graph breaks at data-dependent control flow, compiled
+segments between breaks, guard-based caching). Oracle: plain eager
+execution of the same function.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.ops as F
+from paddle_tpu.jit.graph_break import GraphBreakFunction
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, dtype="float32"))
+
+
+class TestGraphBreak:
+    def test_full_graph_path_when_traceable(self):
+        fn = paddle.jit.to_static(
+            lambda x: F.relu(x) * 2.0, full_graph=False
+        )
+        out = fn(_t([[-1.0, 2.0]]))
+        np.testing.assert_allclose(out.numpy(), [[0.0, 4.0]])
+        assert fn.mode == "full"  # never broke
+
+    def test_data_dependent_if_breaks_and_stays_correct(self):
+        def branchy(x):
+            y = F.abs(x) + 1.0
+            if float(y.sum()) > 10.0:   # data-dependent python branch
+                return y * 2.0
+            return y * 0.5
+
+        fn = paddle.jit.to_static(branchy, full_graph=False)
+        big = _t(np.full((4,), 5.0))
+        small = _t(np.full((4,), 0.5))
+        np.testing.assert_allclose(
+            fn(big).numpy(), branchy(big).numpy()
+        )
+        np.testing.assert_allclose(
+            fn(small).numpy(), branchy(small).numpy()
+        )
+        assert fn.mode == "segment"
+        assert fn.stats["breaks"] == 1
+        # segments actually compiled ops on both sides of the break
+        assert fn.stats["segments"] >= 2
+        assert fn.stats["staged_ops"] >= 4
+
+    def test_data_dependent_while_loop(self):
+        def loop(x):
+            it = 0
+            while float(x.sum()) < 100.0 and it < 50:
+                x = x * 2.0
+                it += 1
+            return x, it
+
+        fn = paddle.jit.to_static(loop, full_graph=False)
+        x = _t([1.0, 1.0])
+        got, iters = fn(x)
+        want, ref_iters = loop(x)
+        assert iters == ref_iters
+        np.testing.assert_allclose(got.numpy(), want.numpy())
+
+    def test_segment_cache_hit_on_recall(self):
+        def branchy(x):
+            y = x + 1.0
+            if float(y.sum()) > 0:
+                return y * 3.0
+            return y
+
+        fn = paddle.jit.to_static(branchy, full_graph=False)
+        fn(_t([1.0]))
+        n_compiled = len(fn._compile_cache)
+        assert n_compiled >= 1
+        fn(_t([2.0]))  # same shapes & ops -> cached programs reused
+        assert len(fn._compile_cache) == n_compiled
+
+    def test_bool_tensor_branch(self):
+        def branchy(x):
+            if (x > 0).all():
+                return x - 1.0
+            return x + 1.0
+
+        fn = paddle.jit.to_static(branchy, full_graph=False)
+        np.testing.assert_allclose(fn(_t([1.0, 2.0])).numpy(), [0.0, 1.0])
+        np.testing.assert_allclose(
+            fn(_t([-1.0, 2.0])).numpy(), [0.0, 3.0]
+        )
+
+    def test_mixed_segments_many_ops(self):
+        def fn_py(x):
+            h = F.tanh(x @ F.transpose(x, [1, 0]))
+            s = float(h.sum())
+            if s > 0:
+                h = F.relu(h - 0.1)
+            else:
+                h = F.sigmoid(h)
+            return (h * 2.0).sum()
+
+        fn = paddle.jit.to_static(fn_py, full_graph=False)
+        x = _t(np.random.RandomState(0).randn(4, 4))
+        np.testing.assert_allclose(
+            float(fn(x).numpy()), float(fn_py(x).numpy()), rtol=1e-5
+        )
+
+    def test_layer_with_grads_falls_back_eager(self):
+        import paddle_tpu.nn as nn
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                y = self.fc(x)
+                if float(y.sum()) > 1e9:  # cold branch, still breaks
+                    return y * 2.0
+                return y
+
+        net = Net()
+        net = paddle.jit.to_static(net, full_graph=False)
+        x = _t(np.random.RandomState(1).randn(2, 4))
+        out = net(x)
+        loss = out.sum()
+        loss.backward()
+        g = net.fc.weight.grad
+        assert g is not None
+        assert np.isfinite(g.numpy()).all()
+        assert isinstance(net.forward, GraphBreakFunction)
+        assert net.forward.stats["eager_calls"] >= 1
+
+    def test_plain_function_trainable_input_falls_back_eager(self):
+        # grads through a broken plain function must NOT be silently
+        # dropped: trainable inputs force the eager fallback
+        def branchy(x):
+            y = x * 2.0
+            if float(y.sum()) > 1e9:
+                return y + 1.0
+            return y
+
+        fn = paddle.jit.to_static(branchy, full_graph=False)
+        x = _t([1.0, 2.0])
+        fn(x)  # trips the break
+        x2 = _t([3.0, 4.0])
+        x2.stop_gradient = False
+        out = fn(x2)
+        out.sum().backward()
+        assert x2.grad is not None
+        np.testing.assert_allclose(x2.grad.numpy(), [2.0, 2.0])
+        assert fn.stats["eager_calls"] >= 1
+
+    def test_full_graph_true_still_raises(self):
+        def branchy(x):
+            if float(x.sum()) > 0:
+                return x
+            return -x
+
+        fn = paddle.jit.to_static(branchy, full_graph=True)
+        with pytest.raises(Exception):
+            fn(_t([1.0]))
