@@ -739,6 +739,43 @@ def interpolate(x, *, size=None, scale_factor=None, mode="nearest",
     return jax.image.resize(x.astype(jnp.float32), out_shape, method=method).astype(x.dtype)
 
 
+def affine_grid(theta, out_shape, *, align_corners=True):
+    """ref: python/paddle/nn/functional/vision.py affine_grid — theta
+    [N, 2, 3] -> grid [N, H, W, 2] (4-D out_shape [N, C, H, W]) or
+    [N, 3, 4] -> [N, D, H, W, 3] (5-D). Pure dot_general lowering; pairs
+    with grid_sample below."""
+    out_shape = [int(s) for s in out_shape]
+    dt = theta.dtype
+
+    def axis_coords(n):
+        if align_corners:
+            if n == 1:
+                return jnp.zeros((1,), dt)
+            return jnp.linspace(-1.0, 1.0, n).astype(dt)
+        return (((jnp.arange(n) * 2 + 1) / n) - 1.0).astype(dt)
+
+    if len(out_shape) == 4:
+        n, _, h, w = out_shape
+        ys, xs = axis_coords(h), axis_coords(w)
+        gx, gy = jnp.meshgrid(xs, ys)            # [h, w] each
+        base = jnp.stack(
+            [gx, gy, jnp.ones_like(gx)], axis=-1
+        )                                        # [h, w, 3]
+        # [n, h, w, 2] = base @ theta^T
+        return jnp.einsum("hwk,nok->nhwo", base, theta.astype(dt))
+    if len(out_shape) == 5:
+        n, _, d, h, w = out_shape
+        zs, ys, xs = axis_coords(d), axis_coords(h), axis_coords(w)
+        gz, gy, gx = jnp.meshgrid(zs, ys, xs, indexing="ij")
+        base = jnp.stack(
+            [gx, gy, gz, jnp.ones_like(gx)], axis=-1
+        )                                        # [d, h, w, 4]
+        return jnp.einsum("dhwk,nok->ndhwo", base, theta.astype(dt))
+    raise ValueError(
+        f"affine_grid expects a 4-D or 5-D out_shape, got {out_shape}"
+    )
+
+
 def grid_sample(x, grid, *, mode="bilinear", padding_mode="zeros", align_corners=True):
     n, c, h, w = x.shape
     gx = grid[..., 0]

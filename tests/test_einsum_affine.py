@@ -1,0 +1,160 @@
+"""einsum (ref python/paddle/tensor/einsum.py contract) + affine_grid.
+
+einsum oracle: numpy.einsum (the reference validates against numpy and
+lowers to its EinsumOp + opt_einsum planning; here the planner is XLA's
+dot_general fusion via jnp.einsum). affine_grid oracle: torch (cpu).
+Grads via the OpTest numeric-difference harness.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.ops as F
+from op_test import check_grad, check_output
+
+
+def _rand(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype("float32")
+
+
+EQS_TWO = [
+    ("ij,jk->ik", (3, 4), (4, 5)),          # matmul
+    ("ij,jk", (3, 4), (4, 5)),              # implicit output
+    ("bij,bjk->bik", (2, 3, 4), (2, 4, 5)),  # batched
+    ("i,i->", (7,), (7,)),                  # dot
+    ("ij,kj->ik", (3, 4), (5, 4)),          # transpose contract
+    ("...ij,...jk->...ik", (2, 3, 4), (2, 4, 5)),  # ellipsis batch
+    ("ij,j->i", (3, 4), (4,)),              # matvec
+]
+
+EQS_ONE = [
+    ("ij->ji", (3, 4)),                     # transpose
+    ("ij->", (3, 4)),                       # full reduction
+    ("ij->j", (3, 4)),                      # axis reduction
+    ("ii->i", (4, 4)),                      # diagonal
+    ("ii->", (4, 4)),                       # trace
+    ("...ij->...ji", (2, 3, 4)),            # ellipsis transpose
+    ("ijk->ikj", (2, 3, 4)),
+]
+
+
+class TestEinsum:
+    @pytest.mark.parametrize("eq,sa,sb", EQS_TWO)
+    def test_two_operand_output(self, eq, sa, sb):
+        a, b = _rand(*sa, seed=1), _rand(*sb, seed=2)
+        got = F.einsum(eq, paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(
+            got.numpy(), np.einsum(eq, a, b), rtol=1e-5, atol=1e-5
+        )
+
+    @pytest.mark.parametrize("eq,sa", EQS_ONE)
+    def test_one_operand_output(self, eq, sa):
+        a = _rand(*sa, seed=3)
+        got = F.einsum(eq, paddle.to_tensor(a))
+        np.testing.assert_allclose(
+            got.numpy(), np.einsum(eq, a), rtol=1e-5, atol=1e-5
+        )
+
+    def test_three_operand_chain(self):
+        a, b, c = _rand(3, 4, seed=4), _rand(4, 5, seed=5), _rand(5, 2, seed=6)
+        got = F.einsum(
+            "ij,jk,kl->il",
+            paddle.to_tensor(a), paddle.to_tensor(b), paddle.to_tensor(c),
+        )
+        np.testing.assert_allclose(
+            got.numpy(), np.einsum("ij,jk,kl->il", a, b, c),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    @pytest.mark.parametrize("eq,sa,sb", [
+        ("ij,jk->ik", (3, 4), (4, 5)),
+        ("...ij,...jk->...ik", (2, 3, 4), (2, 4, 5)),
+        ("bij,bjk->bik", (2, 3, 4), (2, 4, 5)),
+    ])
+    def test_grads_numeric(self, eq, sa, sb):
+        check_grad(
+            lambda x, y, eq: F.einsum(eq, x, y),
+            {"x": _rand(*sa, seed=7), "y": _rand(*sb, seed=8)},
+            attrs={"eq": eq},
+        )
+
+    @pytest.mark.parametrize("eq,sa", [
+        ("ii->i", (4, 4)),      # diagonal grad
+        ("ii->", (4, 4)),       # trace grad
+        ("ij->j", (3, 4)),      # reduction grad
+        ("...ij->...", (2, 3, 4)),
+    ])
+    def test_single_operand_grads_numeric(self, eq, sa):
+        check_grad(
+            lambda x, eq: F.einsum(eq, x),
+            {"x": _rand(*sa, seed=9)},
+            attrs={"eq": eq},
+        )
+
+    def test_invalid_equation_raises(self):
+        a = paddle.to_tensor(_rand(3, 4))
+        with pytest.raises(Exception):
+            F.einsum("ij->iij", a)  # duplicate output labels
+
+    def test_tape_backward_through_attention_pattern(self):
+        q = paddle.to_tensor(_rand(2, 3, 8, seed=10))
+        k = paddle.to_tensor(_rand(2, 5, 8, seed=11))
+        q.stop_gradient = False
+        s = F.einsum("bqd,bkd->bqk", q, k)
+        s.sum().backward()
+        assert q.grad is not None
+        np.testing.assert_allclose(
+            q.grad.numpy(),
+            np.einsum("bqk,bkd->bqd", np.ones((2, 3, 5), "float32"),
+                      k.numpy()),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+class TestAffineGrid:
+    @pytest.mark.parametrize("align", [True, False])
+    def test_matches_torch_2d(self, align):
+        torch = pytest.importorskip("torch")
+        theta = _rand(2, 2, 3, seed=12)
+        shape = [2, 3, 5, 7]
+        got = F.affine_grid(
+            paddle.to_tensor(theta), shape, align_corners=align
+        ).numpy()
+        want = torch.nn.functional.affine_grid(
+            torch.tensor(theta), shape, align_corners=align
+        ).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("align", [True, False])
+    def test_matches_torch_3d(self, align):
+        torch = pytest.importorskip("torch")
+        theta = _rand(2, 3, 4, seed=13)
+        shape = [2, 1, 3, 4, 5]
+        got = F.affine_grid(
+            paddle.to_tensor(theta), shape, align_corners=align
+        ).numpy()
+        want = torch.nn.functional.affine_grid(
+            torch.tensor(theta), shape, align_corners=align
+        ).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_grad_numeric(self):
+        check_grad(
+            lambda theta, out_shape: F.affine_grid(theta, out_shape),
+            {"theta": _rand(1, 2, 3, seed=14)},
+            attrs={"out_shape": [1, 1, 4, 4]},
+        )
+
+    def test_pairs_with_grid_sample_identity(self):
+        # identity theta -> grid_sample reproduces the input
+        x = _rand(1, 2, 6, 6, seed=15)
+        theta = np.tile(
+            np.array([[[1.0, 0, 0], [0, 1.0, 0]]], "float32"), (1, 1, 1)
+        )
+        grid = F.affine_grid(
+            paddle.to_tensor(theta), [1, 2, 6, 6], align_corners=True
+        )
+        out = F.grid_sample(
+            paddle.to_tensor(x), grid, align_corners=True
+        )
+        np.testing.assert_allclose(out.numpy(), x, rtol=1e-4, atol=1e-5)
