@@ -1,5 +1,6 @@
 """paddle.incubate analogue — LLM fused building blocks + MoE (ref:
 python/paddle/incubate/nn/functional/*, incubate/distributed/models/moe)."""
+from . import asp
 from . import nn
 from .moe import MoELayer, SwiGLUExperts, TopKGate
 

@@ -1,0 +1,195 @@
+"""ASP: automatic n:m structured sparsity.
+
+ref: python/paddle/incubate/asp/{asp.py:319 prune_model, :233 decorate,
+:55 set_excluded_layers} and utils.py (get_mask_1d:192,
+get_mask_2d_greedy:334, check_mask_1d:142, create_mask:508,
+check_sparsity:584). The reference generates 2:4 masks for cuSPARSElt
+kernels; on TPU there is no sparse-MXU path, so the masks are applied as
+multiplies (XLA folds them into the weight constant) — the training-time
+semantics (mask weights, keep masked weights zero through optimizer
+steps via decorate()) are identical.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "calculate_density", "check_mask_1d", "get_mask_1d",
+    "check_mask_2d", "get_mask_2d_greedy", "create_mask",
+    "check_sparsity", "prune_model", "decorate",
+    "set_excluded_layers", "reset_excluded_layers",
+]
+
+_excluded_layers: set[int] = set()
+
+
+def calculate_density(x) -> float:
+    """ref utils.py:86."""
+    a = np.asarray(x)
+    return float(np.count_nonzero(a)) / a.size
+
+
+def _reshape_1d(mat, m):
+    pad = (-mat.shape[1]) % m
+    if pad:
+        mat = np.concatenate(
+            [mat, np.zeros((mat.shape[0], pad), mat.dtype)], axis=1
+        )
+    return mat.reshape(-1, m), mat.shape
+
+
+def check_mask_1d(mat, n, m) -> bool:
+    """Every m-wide group keeps at most (m - n) nonzeros... the
+    reference contract: at least n zeros per group (utils.py:142)."""
+    groups, _ = _reshape_1d(np.asarray(mat), m)
+    return bool(((groups != 0).sum(axis=1) <= (m - n)).all())
+
+
+def get_mask_1d(mat, n, m):
+    """Keep the (m - n) largest |values| of every m-wide group
+    (ref utils.py:192)."""
+    a = np.asarray(mat)
+    groups, padded_shape = _reshape_1d(a, m)
+    keep = m - n
+    order = np.argsort(-np.abs(groups), axis=1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[:, :keep], 1.0, axis=1)
+    mask = mask.reshape(padded_shape)[:, : a.shape[1]]
+    return mask.astype(a.dtype)
+
+
+def check_mask_2d(mat, n, m) -> bool:
+    """Every m x m block has at most (m - n) nonzeros per row AND per
+    column (ref utils.py:277)."""
+    a = np.asarray(mat)
+    pr, pc = (-a.shape[0]) % m, (-a.shape[1]) % m
+    a = np.pad(a, ((0, pr), (0, pc)))
+    keep = m - n
+    for i in range(0, a.shape[0], m):
+        for j in range(0, a.shape[1], m):
+            blk = a[i:i + m, j:j + m] != 0
+            if (blk.sum(0) > keep).any() or (blk.sum(1) > keep).any():
+                return False
+    return True
+
+
+def get_mask_2d_greedy(mat, n, m):
+    """Greedy 2-D n:m mask: per m x m block, take entries in descending
+    |value| while row/col budgets (m - n) allow (ref utils.py:334)."""
+    a = np.asarray(mat)
+    pr, pc = (-a.shape[0]) % m, (-a.shape[1]) % m
+    p = np.pad(a, ((0, pr), (0, pc)))
+    mask = np.zeros_like(p)
+    keep = m - n
+    for i in range(0, p.shape[0], m):
+        for j in range(0, p.shape[1], m):
+            blk = np.abs(p[i:i + m, j:j + m])
+            rows = np.zeros(m, int)
+            cols = np.zeros(m, int)
+            order = np.dstack(
+                np.unravel_index(np.argsort(-blk, axis=None), blk.shape)
+            )[0]
+            for r, c in order:
+                if rows[r] < keep and cols[c] < keep:
+                    mask[i + r, j + c] = 1.0
+                    rows[r] += 1
+                    cols[c] += 1
+    return mask[: a.shape[0], : a.shape[1]].astype(a.dtype)
+
+
+def create_mask(tensor, func_name="get_mask_1d", n=2, m=4):
+    """ref utils.py:508 — 1-D/2-D mask over the LAST axis pairs;
+    >2-D tensors are masked on a [prod(leading), last] view."""
+    fn = {"get_mask_1d": get_mask_1d,
+          "get_mask_2d_greedy": get_mask_2d_greedy}[func_name]
+    a = np.asarray(tensor)
+    shape = a.shape
+    if a.ndim == 1:
+        return fn(a[None], n, m)[0].reshape(shape)
+    view = a.reshape(-1, shape[-1])
+    return fn(view, n, m).reshape(shape)
+
+
+def check_sparsity(tensor, func_name="check_mask_1d", n=2, m=4) -> bool:
+    """ref utils.py:584."""
+    fn = {"check_mask_1d": check_mask_1d,
+          "check_mask_2d": check_mask_2d}[func_name]
+    a = np.asarray(tensor)
+    if a.ndim == 1:
+        return fn(a[None], n, m)
+    return fn(a.reshape(-1, a.shape[-1]), n, m)
+
+
+def set_excluded_layers(layers, main_program=None):
+    """ref asp.py:55 — layers (or sublayers) whose params prune_model
+    must leave dense."""
+    for lyr in layers if isinstance(layers, (list, tuple)) else [layers]:
+        for _, sub in lyr.named_sublayers(include_self=True):
+            _excluded_layers.add(id(sub))
+
+
+def reset_excluded_layers(main_program=None):
+    """ref asp.py:144."""
+    _excluded_layers.clear()
+
+
+def _prunable_params(model):
+    for _, sub in model.named_sublayers(include_self=True):
+        if id(sub) in _excluded_layers:
+            continue
+        kind = type(sub).__name__
+        if kind not in ("Linear", "Conv2D", "Conv1D", "Conv3D"):
+            continue
+        w = getattr(sub, "weight", None)
+        if w is None or w.ndim < 2:
+            continue
+        if min(w.shape[-1], int(np.prod(w.shape[:-1]))) < 4:
+            continue  # too small to hold an n:m pattern
+        yield w
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to every supported layer's weight and remember
+    them so decorate()d optimizers keep pruned weights at zero
+    (ref asp.py:319). Returns {param_name_or_id: mask}."""
+    import jax.numpy as jnp
+
+    algo = {"mask_1d": "get_mask_1d",
+            "mask_2d_greedy": "get_mask_2d_greedy"}[mask_algo]
+    out = {}
+    for w in _prunable_params(model):
+        mask = create_mask(w.numpy(), func_name=algo, n=n, m=m)
+        w._rebind(jnp.asarray(w.numpy() * mask))
+        if with_mask:
+            # mask lives ON the parameter (not a global id-keyed table:
+            # CPython id reuse could apply a dead model's mask to a new
+            # param, and a module dict would pin masks forever)
+            w._asp_mask = mask
+        out[w.name or id(w)] = mask
+    return out
+
+
+class OptimizerWithSparsityGuarantee:
+    """ref asp.py:233 decorate — re-applies the masks after every
+    optimizer step so pruned weights stay exactly zero through
+    training."""
+
+    def __init__(self, optimizer):
+        self._opt = optimizer
+
+    def step(self, *a, **kw):
+        import jax.numpy as jnp
+
+        out = self._opt.step(*a, **kw)
+        for p in self._opt._parameter_list:
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._rebind(p._data * jnp.asarray(mask, p._data.dtype))
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._opt, name)
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)

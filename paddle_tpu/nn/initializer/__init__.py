@@ -173,6 +173,37 @@ MSRA = KaimingNormal
 
 _global_initializer = {"weight": XavierNormal(), "bias": Constant(0.0)}
 
+# Forced override (strongest precedence): create_parameter consults this
+# FIRST — the fast-init path for huge-model bring-up where per-param RNG
+# would dominate wall clock (e.g. the 8B dryrun: 8e9 gaussians on one
+# host core). Use via the context manager below.
+_init_override = {"initializer": None, "dtype": None}
+
+
+class param_init_override:
+    """Force every ``create_parameter`` inside the context to use this
+    initializer and/or dtype, overriding layer defaults and ParamAttr.
+
+        with param_init_override(Constant(0.0), dtype="bfloat16"):
+            model = LlamaForCausalLM(cfg)   # zero-filled bf16 params
+    """
+
+    def __init__(self, initializer=None, dtype=None):
+        self._init = initializer
+        self._dtype = dtype
+
+    def __enter__(self):
+        self._saved = dict(_init_override)
+        if self._init is not None:
+            _init_override["initializer"] = self._init
+        if self._dtype is not None:
+            _init_override["dtype"] = self._dtype
+        return self
+
+    def __exit__(self, *exc):
+        _init_override.update(self._saved)
+        return False
+
 
 def set_global_initializer(weight_init, bias_init=None):
     _global_initializer["weight"] = weight_init

@@ -50,9 +50,10 @@ class Layer:
         default_initializer=None,
     ):
         attr = ParamAttr._to_attr(attr)
-        dtype = dtype or self._dtype
+        dtype = I._init_override["dtype"] or dtype or self._dtype
         init = (
-            attr.initializer
+            I._init_override["initializer"]
+            or attr.initializer
             or default_initializer
             or (I.Constant(0.0) if is_bias else I._global_initializer["weight"])
         )

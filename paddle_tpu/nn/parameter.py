@@ -8,7 +8,8 @@ from ..core.tensor import Tensor
 
 
 class Parameter(Tensor):
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "_asp_mask")  # n:m sparsity mask (incubate.asp)
 
     def __init__(self, data, trainable=True, name=None):
         super().__init__(data, stop_gradient=not trainable, name=name)

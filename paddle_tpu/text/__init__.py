@@ -1,0 +1,252 @@
+"""paddle.text analogue: viterbi_decode / ViterbiDecoder + text datasets.
+
+ref: python/paddle/text/{__init__.py, viterbi_decode.py:31,110} and
+text/datasets/{uci_housing,imikolov,imdb}.py. The reference datasets
+self-download from public mirrors; this environment has no egress, so
+every dataset takes an explicit ``data_file`` path and raises a clear
+error when asked to download (the parsing logic is the reference's).
+"""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..io.dataset import Dataset
+from ..nn.layer.layers import Layer
+
+__all__ = [
+    "viterbi_decode", "ViterbiDecoder",
+    "UCIHousing", "Imikolov", "Imdb",
+]
+
+
+def _viterbi_impl(potentials, transition, lengths, *,
+                  include_bos_eos_tag=True):
+    b, L, n = potentials.shape
+    lengths = lengths.astype(jnp.int32)
+    start_row = transition[n - 1] if include_bos_eos_tag else 0.0
+    alpha0 = potentials[:, 0].astype(jnp.float32) + start_row
+
+    def step(alpha, t):
+        # m[b, i, j] = alpha[b, i] + trans[i, j]
+        m = alpha[:, :, None] + transition[None].astype(jnp.float32)
+        best = m.max(axis=1)
+        arg = m.argmax(axis=1).astype(jnp.int32)          # [b, n]
+        new_alpha = best + potentials[:, t].astype(jnp.float32)
+        active = (t < lengths)[:, None]
+        alpha = jnp.where(active, new_alpha, alpha)
+        # frozen steps backtrack through the identity
+        arg = jnp.where(active, arg, jnp.arange(n, dtype=jnp.int32)[None])
+        return alpha, arg
+
+    alpha, hist = jax.lax.scan(step, alpha0, jnp.arange(1, L))
+    if include_bos_eos_tag:
+        alpha = alpha + transition[:, n - 2][None].astype(jnp.float32)
+    scores = alpha.max(-1).astype(potentials.dtype)
+    last = alpha.argmax(-1).astype(jnp.int32)             # [b]
+
+    def back(tag, arg_t):
+        prev = jnp.take_along_axis(arg_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    if L > 1:
+        # reverse scan emits the tag at position k+1 while consuming
+        # hist[k]; the final carry is the tag at position 0
+        first, path_rev = jax.lax.scan(back, last, hist, reverse=True)
+        path = jnp.concatenate(
+            [first[:, None], jnp.swapaxes(path_rev, 0, 1)], axis=1
+        )
+    else:
+        path = last[:, None]
+    # positions past each sequence's length are zeroed (kernel contract).
+    # int32, not the reference's int64: x64 is off by default under JAX
+    # and an int64 astype would silently truncate with a warning
+    mask = jnp.arange(L)[None] < lengths[:, None]
+    path = jnp.where(mask, path, 0).astype(jnp.int32)
+    return scores, path
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag path of a linear-chain CRF
+    (ref text/viterbi_decode.py:31; kernel
+    phi/kernels/cpu/viterbi_decode_kernel.cc). Returns
+    (scores [b], paths [b, max(lengths)] int64)."""
+    scores, path = dispatch.call(
+        "viterbi_decode", _viterbi_impl,
+        (potentials, transition_params, lengths),
+        {"include_bos_eos_tag": include_bos_eos_tag},
+    )
+    maxlen = int(np.asarray(
+        lengths.numpy() if isinstance(lengths, Tensor) else lengths
+    ).max())
+    return scores, path[:, :maxlen]
+
+
+class ViterbiDecoder(Layer):
+    """ref text/viterbi_decode.py:110."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(
+            potentials, self.transitions, lengths,
+            self.include_bos_eos_tag,
+        )
+
+
+def _need_file(data_file, what):
+    if data_file is None or not os.path.exists(data_file):
+        raise ValueError(
+            f"{what}: no network egress in this environment — pass "
+            f"data_file= pointing at a local copy (the reference would "
+            f"download it; ref text/datasets)"
+        )
+    return data_file
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (ref text/datasets/uci_housing.py):
+    whitespace-separated numeric table, 13 features + 1 target,
+    feature-normalized, 80/20 train/test split."""
+
+    def __init__(self, data_file=None, mode="train"):
+        data_file = _need_file(data_file, "UCIHousing")
+        raw = np.loadtxt(data_file).astype("float32")
+        feats = raw[:, :-1]
+        mx, mn, avg = feats.max(0), feats.min(0), feats.mean(0)
+        feats = (feats - avg) / np.maximum(mx - mn, 1e-8)
+        raw = np.concatenate([feats, raw[:, -1:]], axis=1)
+        split = int(len(raw) * 0.8)
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        row = self.data[i]
+        return row[:-1], row[-1:]
+
+
+class Imikolov(Dataset):
+    """PTB n-gram dataset (ref text/datasets/imikolov.py): builds the
+    vocabulary from the train split (min word freq cut), yields n-gram
+    index tuples."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        data_file = _need_file(data_file, "Imikolov")
+        self.window_size = window_size
+        self.data_type = data_type.upper()
+        with tarfile.open(data_file) as tf:
+            names = tf.getnames()
+
+            def read(which):
+                # exact word-level file (the real PTB tarball also holds
+                # ptb.char.train.txt — substring matching would silently
+                # pick the character corpus; ref reads
+                # simple-examples/data/ptb.train.txt)
+                cands = [n for n in names
+                         if n.endswith(f"{which}.txt")
+                         and ".char." not in n]
+                if not cands:
+                    raise ValueError(
+                        f"Imikolov: no *{which}.txt member in {data_file}"
+                    )
+                return tf.extractfile(
+                    sorted(cands, key=len)[0]
+                ).read().decode().split("\n")
+
+            train_lines = read("train")
+            lines = train_lines if mode == "train" else read("valid")
+        freq = {}
+        for ln in train_lines:
+            for w in ln.strip().split():
+                freq[w] = freq.get(w, 0) + 1
+        words = sorted(
+            (w for w, c in freq.items() if c >= min_word_freq and
+             w != "<unk>"),
+            key=lambda w: (-freq[w], w),
+        )
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for ln in lines:
+            toks = ["<s>"] + ln.strip().split() + ["<e>"]
+            ids = [self.word_idx.get(w, unk) for w in toks]
+            if self.data_type == "NGRAM":
+                for i in range(len(ids) - window_size + 1):
+                    self.data.append(
+                        tuple(ids[i:i + window_size])
+                    )
+            else:  # SEQ
+                if len(ids) > 2:
+                    self.data.append((ids[:-1], ids[1:]))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return tuple(np.asarray(x, dtype="int64") for x in self.data[i])
+
+
+class Imdb(Dataset):
+    """IMDB sentiment dataset (ref text/datasets/imdb.py): tokenized
+    reviews -> word indices + 0/1 label, vocabulary from the train
+    split."""
+
+    _tokenize = staticmethod(
+        lambda s: re.sub(r"[^a-z\s]", "", s.lower()).split()
+    )
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        data_file = _need_file(data_file, "Imdb")
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        train_pat = re.compile(r"aclImdb/train/(pos|neg)/.*\.txt$")
+        freq = {}
+        docs = []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                is_train = bool(train_pat.match(m.name))
+                is_mine = bool(pat.match(m.name))
+                if not (is_train or is_mine):
+                    continue
+                toks = self._tokenize(
+                    tf.extractfile(m).read().decode("utf-8", "ignore")
+                )
+                if is_train:
+                    for w in toks:
+                        freq[w] = freq.get(w, 0) + 1
+                if is_mine:
+                    label = 0 if "/pos/" in m.name else 1
+                    docs.append((toks, label))
+        words = sorted(
+            (w for w, c in freq.items() if c >= cutoff),
+            key=lambda w: (-freq[w], w),
+        )
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        self.word_idx["<unk>"] = unk = len(self.word_idx)
+        self.docs = [
+            (np.asarray([self.word_idx.get(w, unk) for w in toks],
+                        dtype="int64"),
+             np.asarray(label, dtype="int64"))
+            for toks, label in docs
+        ]
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i]

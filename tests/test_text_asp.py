@@ -1,0 +1,194 @@
+"""paddle.text (viterbi_decode, datasets) + incubate.asp n:m sparsity.
+
+Viterbi oracle: brute force over all tag paths. ASP oracle: the
+reference's mask contracts (utils.py): n zeros per m-group, magnitude
+keep, masked weights stay zero through decorated optimizer steps.
+Dataset tests synthesize files in the reference formats.
+"""
+import io
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import asp
+from paddle_tpu.text import (
+    Imdb,
+    Imikolov,
+    UCIHousing,
+    ViterbiDecoder,
+    viterbi_decode,
+)
+
+
+def _brute_viterbi(pot, trans, length, include):
+    b, L, n = pot.shape
+    scores, paths = [], []
+    import itertools
+
+    for bi in range(b):
+        best, best_path = -1e30, None
+        for path in itertools.product(range(n), repeat=int(length[bi])):
+            s = pot[bi, 0, path[0]]
+            if include:
+                s += trans[n - 1, path[0]]
+            for t in range(1, len(path)):
+                s += trans[path[t - 1], path[t]] + pot[bi, t, path[t]]
+            if include:
+                s += trans[path[-1], n - 2]
+            if s > best:
+                best, best_path = s, path
+        scores.append(best)
+        paths.append(list(best_path))
+    return np.asarray(scores, "float32"), paths
+
+
+class TestViterbi:
+    @pytest.mark.parametrize("include", [False, True])
+    def test_matches_brute_force(self, include):
+        rng = np.random.RandomState(0)
+        b, L, n = 3, 4, 4
+        pot = rng.randn(b, L, n).astype("float32")
+        trans = rng.randn(n, n).astype("float32")
+        lengths = np.array([4, 2, 3], "int64")
+        scores, path = viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lengths), include_bos_eos_tag=include,
+        )
+        ref_s, ref_p = _brute_viterbi(pot, trans, lengths, include)
+        np.testing.assert_allclose(scores.numpy(), ref_s, rtol=1e-5)
+        got = path.numpy()
+        assert got.shape == (3, 4)  # max length
+        for bi in range(b):
+            assert list(got[bi, : lengths[bi]]) == ref_p[bi]
+            assert (got[bi, lengths[bi]:] == 0).all()
+
+    def test_decoder_layer(self):
+        rng = np.random.RandomState(1)
+        trans = paddle.to_tensor(rng.randn(3, 3).astype("float32"))
+        dec = ViterbiDecoder(trans, include_bos_eos_tag=False)
+        pot = paddle.to_tensor(rng.randn(2, 3, 3).astype("float32"))
+        lengths = paddle.to_tensor(np.array([3, 3], "int64"))
+        scores, path = dec(pot, lengths)
+        assert scores.shape == [2] and path.shape == [2, 3]
+
+
+class TestDatasets:
+    def test_uci_housing(self, tmp_path):
+        rng = np.random.RandomState(0)
+        rows = rng.rand(50, 14).astype("float32")
+        f = tmp_path / "housing.data"
+        np.savetxt(f, rows)
+        tr = UCIHousing(data_file=str(f), mode="train")
+        te = UCIHousing(data_file=str(f), mode="test")
+        assert len(tr) == 40 and len(te) == 10
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        # features are normalized to ~[-1, 1]
+        assert np.abs(np.stack([tr[i][0] for i in range(40)])).max() <= 1.0
+
+    def test_imikolov_ngram(self, tmp_path):
+        text = "the cat sat\nthe dog sat\nthe cat ran\n"
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+            for split in ("train.txt", "valid.txt"):
+                data = text.encode()
+                info = tarfile.TarInfo(f"simple/{split}")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        f = tmp_path / "imikolov.tar.gz"
+        f.write_bytes(buf.getvalue())
+        ds = Imikolov(data_file=str(f), window_size=3, mode="train",
+                      min_word_freq=2)
+        assert len(ds) > 0
+        for tup in ds:
+            assert len(tup) == 3
+        # 'the' (freq 3) and 'sat'/'cat' (freq 2) are in vocab
+        assert "the" in ds.word_idx and "<unk>" in ds.word_idx
+
+    def test_imdb(self, tmp_path):
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+            for i, (split, pol, txt) in enumerate([
+                ("train", "pos", "good great movie movie"),
+                ("train", "neg", "bad awful movie movie"),
+                ("test", "pos", "great movie"),
+                ("test", "neg", "awful movie"),
+            ]):
+                data = txt.encode()
+                info = tarfile.TarInfo(f"aclImdb/{split}/{pol}/{i}.txt")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        f = tmp_path / "imdb.tar.gz"
+        f.write_bytes(buf.getvalue())
+        tr = Imdb(data_file=str(f), mode="train", cutoff=2)
+        te = Imdb(data_file=str(f), mode="test", cutoff=2)
+        assert len(tr) == 2 and len(te) == 2
+        doc, label = tr[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        assert "movie" in tr.word_idx
+
+    def test_missing_file_raises(self):
+        with pytest.raises(ValueError, match="no network egress"):
+            UCIHousing(data_file=None)
+
+
+class TestASP:
+    def test_mask_1d_contract(self):
+        rng = np.random.RandomState(0)
+        w = rng.randn(8, 16).astype("float32")
+        mask = asp.get_mask_1d(w, 2, 4)
+        assert asp.check_mask_1d(mask, 2, 4)
+        assert asp.calculate_density(mask) == 0.5
+        # magnitude contract: kept entries are each group's top-2 |w|
+        groups = (w * mask).reshape(-1, 4)
+        ref = np.sort(np.abs(w.reshape(-1, 4)), axis=1)[:, 2:]
+        np.testing.assert_allclose(
+            np.sort(np.abs(groups), axis=1)[:, 2:], ref
+        )
+
+    def test_mask_2d_contract(self):
+        rng = np.random.RandomState(1)
+        w = rng.randn(8, 8).astype("float32")
+        mask = asp.get_mask_2d_greedy(w, 2, 4)
+        assert asp.check_mask_2d(mask, 2, 4)
+        assert 0.25 <= asp.calculate_density(mask) <= 0.5
+
+    def test_prune_model_and_decorate(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        model = nn.Sequential(
+            nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8)
+        )
+        masks = asp.prune_model(model, n=2, m=4)
+        assert len(masks) == 2
+        for lyr in (model[0], model[2]):
+            assert asp.check_sparsity(lyr.weight.numpy())
+        opt = asp.decorate(paddle.optimizer.Momentum(
+            learning_rate=0.1, parameters=model.parameters()
+        ))
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 16).astype("float32"))
+        for _ in range(3):
+            loss = model(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        # pruned weights stayed exactly zero through training
+        for lyr in (model[0], model[2]):
+            assert asp.check_sparsity(lyr.weight.numpy())
+        # and the dense weights did move
+        assert float(np.abs(model[0].weight.numpy()).sum()) > 0
+
+    def test_excluded_layers(self):
+        import paddle_tpu.nn as nn
+
+        asp.reset_excluded_layers()
+        model = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+        asp.set_excluded_layers([model[0]])
+        masks = asp.prune_model(model, n=2, m=4)
+        assert len(masks) == 1
+        assert not asp.check_sparsity(model[0].weight.numpy())
+        asp.reset_excluded_layers()
