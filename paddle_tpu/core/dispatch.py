@@ -32,6 +32,10 @@ _dist_dispatch_hook: Callable | None = None
 # Installed by jit.graph_break's segment scope: records ops into a lazy
 # compiled segment instead of executing them (SOT-fallback mode).
 _segment_hook: Callable | None = None
+# Installed by profiler while RECORDing: per-op host+device timing
+# (block_until_ready inside the timed span — the profiling-overhead
+# trade the reference's tracers also make).
+_prof_timer: Callable | None = None
 
 
 def set_amp_hook(fn):
@@ -139,11 +143,23 @@ def call(op_name: str, impl: Callable, args: tuple, attrs: dict[str, Any]):
         rebuilt_args = jax.tree_util.tree_unflatten(treedef, rebuilt)
         return impl(*rebuilt_args, **attrs)
 
+    timer = _prof_timer  # capture: stop() on another thread may clear it
+    t_prof = None
+    if timer is not None:
+        import time as _time
+
+        t_prof = _time.perf_counter()
     if requires_grad:
         out, vjp_fn = jax.vjp(fn, *primals)
     else:
         out = fn(*primals)
         vjp_fn = None
+    if t_prof is not None:
+        try:
+            jax.block_until_ready(out)
+        except Exception:
+            pass  # tracers under an outer jit: host time only
+        timer(op_name, _time.perf_counter() - t_prof)
 
     out_flat, out_treedef = jax.tree_util.tree_flatten(out)
     # float0 leaves (cotangents of integral inputs, from grad-of-grad ops)

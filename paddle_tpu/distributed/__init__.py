@@ -66,6 +66,13 @@ from .placement import Partial, Placement, Replicate, Shard
 from .sequence_parallel import gather_sequence, ring_attention, split_sequence
 from .process_mesh import ProcessMesh
 from .store import TCPStore
+from .spawn import spawn
+from . import rpc
+from .watchdog import (
+    disable_comm_watchdog,
+    enable_comm_watchdog,
+    get_comm_watchdog,
+)
 
 _dispatch.set_dist_hook(_dist_dispatch)
 
@@ -85,5 +92,6 @@ __all__ = [
     "DataParallel", "shard_layer", "shard_optimizer", "default_mesh",
     "ShardingStage1", "ShardingStage2", "ShardingStage3",
     "group_sharded_parallel",
-    "checkpoint", "TCPStore",
+    "checkpoint", "TCPStore", "spawn", "rpc",
+    "enable_comm_watchdog", "disable_comm_watchdog", "get_comm_watchdog",
 ]

@@ -104,6 +104,24 @@ def destroy_process_group(group=None):
         _groups.pop(group.id, None)
 
 
+def _watched(fn):
+    """Run a collective under the comm watchdog when one is enabled
+    (ref comm_task_manager.h:37 — every NCCL task is watchdog-tracked)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrap(*a, **kw):
+        from .watchdog import get_comm_watchdog
+
+        wd = get_comm_watchdog()
+        if wd is None:
+            return fn(*a, **kw)
+        with wd.watch(fn.__name__):
+            return fn(*a, **kw)
+
+    return wrap
+
+
 def _member_rank(g, rank, what):
     r = g.get_group_rank(rank)
     if r < 0:
@@ -130,6 +148,7 @@ def _stacked(x, group):
     return x, g
 
 
+@_watched
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """Every rank ends with the elementwise reduction (ref
     communication/all_reduce.py). Stacked form: out[r] = reduce_r' x[r']."""
@@ -148,6 +167,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     return out
 
 
+@_watched
 def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True):
     """out[r] = concat(x[0], ..., x[n-1]) for every r (ref
     communication/all_gather.py). Returns the stacked gathered tensor;
@@ -165,6 +185,7 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True):
     return out_list
 
 
+@_watched
 def all_to_all(out_tensor_list, in_tensor_list=None, group=None,
                sync_op=True):
     """out[r][j] = in[j][r] (ref communication/all_to_all.py). Stacked
@@ -190,6 +211,7 @@ def all_to_all(out_tensor_list, in_tensor_list=None, group=None,
     return out_tensor_list
 
 
+@_watched
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """out[r] = x[src_group_rank] (ref communication/broadcast.py)."""
     from .. import ops as F
@@ -206,6 +228,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     return out
 
 
+@_watched
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     """Only dst ends with the reduction; others keep their input (ref
     communication/reduce.py)."""
@@ -225,6 +248,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return out
 
 
+@_watched
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     """Rank r gets the r-th chunk of the reduction (ref
@@ -249,6 +273,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
     return out
 
 
+@_watched
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     """Rank r gets chunk r of src's value (ref communication/scatter.py).
     List API: tensor_list holds src's per-rank chunks."""
@@ -271,6 +296,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return out
 
 
+@_watched
 def barrier(group=None):
     """Device sync (XLA has no cross-op barrier need; block on a token)."""
     import jax

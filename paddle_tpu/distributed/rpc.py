@@ -1,0 +1,174 @@
+"""paddle.distributed.rpc analogue.
+
+ref: python/paddle/distributed/rpc/rpc.py (init_rpc, rpc_sync,
+rpc_async, shutdown, get_worker_info / WorkerInfo) over the brpc
+RpcAgent (fluid/distributed/rpc/rpc_agent.h).
+
+TPU-native form: one lightweight TCP server thread per worker; workers
+discover each other through the TCPStore (the reference likewise
+rendezvouses worker endpoints through its master store). Payloads are
+pickled python callables + args — the reference's serialization contract
+(cloudpickle over brpc) and trust model: RPC is code execution by
+design, for peers inside one training cluster.
+"""
+from __future__ import annotations
+
+import concurrent.futures as _fut
+import pickle
+import socket
+import socketserver
+import threading
+
+from .store import TCPStore
+
+__all__ = [
+    "init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+    "get_all_worker_infos", "WorkerInfo",
+]
+
+
+class WorkerInfo:
+    """ref rpc/rpc.py WorkerInfo(name, rank, ip, port)."""
+
+    def __init__(self, name, rank, ip, port):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name}, rank={self.rank}, "
+                f"ip={self.ip}, port={self.port})")
+
+
+_state: dict = {}
+
+
+def _recv_exact(sock, n):
+    """Read exactly n bytes or return None on EOF (shared by server and
+    client sides of the 8-byte-length pickle framing)."""
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    head = _recv_exact(sock, 8)
+    if head is None:
+        return None
+    return _recv_exact(sock, int.from_bytes(head, "big"))
+
+
+class _RpcHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        buf = _recv_msg(self.request)
+        if buf is None:
+            return
+        try:
+            fn, args, kwargs = pickle.loads(buf)
+            result = (True, fn(*args, **kwargs))
+        except Exception as e:  # ship the failure back to the caller
+            result = (False, e)
+        payload = pickle.dumps(result)
+        self.request.sendall(len(payload).to_bytes(8, "big") + payload)
+
+
+class _RpcServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC service and rendezvous all workers'
+    endpoints through the store (ref rpc/rpc.py:init_rpc)."""
+    import os
+
+    rank = rank if rank is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world_size = world_size or int(
+        os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:29590")
+    host, port = master_endpoint.rsplit(":", 1)
+
+    server = _RpcServer(("0.0.0.0", 0), _RpcHandler)
+    my_port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    store = TCPStore(host, int(port) + 7, is_master=rank == 0, timeout=60)
+    my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else (
+        socket.gethostbyname(socket.gethostname()))
+    store.set(f"rpc/{rank}", f"{name},{my_ip},{my_port}")
+    infos = {}
+    for r in range(world_size):
+        nm, ip, p = store.get(f"rpc/{r}").split(",")
+        infos[nm] = WorkerInfo(nm, r, ip, int(p))
+    _state.update(
+        server=server, store=store, infos=infos, rank=rank, name=name,
+        pool=_fut.ThreadPoolExecutor(max_workers=8),
+    )
+    # all workers up before anyone issues calls
+    store.barrier("rpc_init", world_size)
+    return infos[name]
+
+
+def get_worker_info(name=None):
+    infos = _state.get("infos") or {}
+    if name is None:
+        return infos.get(_state.get("name"))
+    return infos[name]
+
+
+def get_all_worker_infos():
+    return list((_state.get("infos") or {}).values())
+
+
+def _call(to, fn, args, kwargs, timeout):
+    info = _state["infos"][to] if isinstance(to, str) else to
+    payload = pickle.dumps((fn, args or (), kwargs or {}))
+    with socket.create_connection((info.ip, info.port),
+                                  timeout=timeout) as s:
+        s.sendall(len(payload).to_bytes(8, "big") + payload)
+        buf = _recv_msg(s)
+        if buf is None:
+            raise ConnectionError("rpc peer closed the connection")
+    ok, value = pickle.loads(buf)
+    if not ok:
+        raise value
+    return value
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=180.0):
+    """Blocking remote call (ref rpc/rpc.py:rpc_sync)."""
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=180.0):
+    """Returns a Future (ref rpc/rpc.py:rpc_async -> FutureWrapper;
+    .wait() for the result)."""
+    fut = _state["pool"].submit(_call, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # paddle Future API
+    return fut
+
+
+def shutdown():
+    """ref rpc/rpc.py:shutdown — barrier, then stop serving."""
+    if not _state:
+        return
+    try:
+        world = len(_state["infos"])
+        _state["store"].barrier("rpc_shutdown", world)
+    except Exception:
+        pass
+    _state["server"].shutdown()
+    _state["server"].server_close()
+    _state["pool"].shutdown(wait=False)
+    try:
+        _state["store"].close()
+    except Exception:
+        pass
+    _state.clear()

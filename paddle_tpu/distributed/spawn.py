@@ -1,0 +1,71 @@
+"""paddle.distributed.spawn — multiprocessing launch alternative.
+
+ref: python/paddle/distributed/spawn.py (spawn(func, args, nprocs,
+join): per-rank subprocesses with the trainer env contract, error
+collection, join semantics). On TPU one process drives all local chips,
+so spawn is the CPU-backend/test-harness path; forked workers get
+PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM and a reset parallel context.
+"""
+from __future__ import annotations
+
+import multiprocessing as _mp
+import os
+import traceback
+
+__all__ = ["spawn"]
+
+
+def _worker(rank, nprocs, func, args, err_q):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_LOCAL_RANK"] = str(rank)
+    from . import parallel
+
+    parallel._parallel_env = None  # forked copy must re-read the env
+    try:
+        func(*args)
+    except Exception:
+        err_q.put((rank, traceback.format_exc()))
+        raise SystemExit(1)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Launch ``func(*args)`` in ``nprocs`` processes with the trainer
+    env contract (ref spawn.py). Returns the context (list of processes)
+    when join=False; raises if any worker fails."""
+    if nprocs <= 0:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    ctx = _mp.get_context("fork")
+    err_q = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=_worker, args=(r, nprocs, func, args, err_q),
+            daemon=daemon,
+        )
+        for r in range(nprocs)
+    ]
+    for p in procs:
+        p.start()
+    if not join:
+        return procs
+    for p in procs:
+        p.join()
+    bad = [p.exitcode for p in procs if p.exitcode]
+    failures = []
+    # one traceback is queued per failed worker; empty()-polling races
+    # the queue feeder, so get with a timeout per expected failure
+    import queue as _queue
+
+    for _ in bad:
+        try:
+            failures.append(err_q.get(timeout=2))
+        except _queue.Empty:
+            break
+    if failures:
+        rank, tb = failures[0]
+        raise RuntimeError(
+            f"spawn: worker {rank} failed:\n{tb}"
+        )
+    if bad:
+        raise RuntimeError(f"spawn: workers exited nonzero: {bad}")
+    return None

@@ -1,0 +1,165 @@
+"""Communication watchdog: hung-collective detection + propagated abort.
+
+ref: phi/core/distributed/comm_task_manager.h:37 (CommTaskManager — a
+background loop that watches enqueued NCCL tasks, times out hung ones,
+dumps debug state, and propagates the abort to peer ranks through the
+TCPStore) and nccl_comm_task.cc.
+
+TPU-native form: XLA collectives are compiled into programs, so the
+watchable unit is a host-side span (a collective call, a whole train
+step, a checkpoint barrier). ``watch(tag)`` registers a deadline with
+the background thread; on expiry the watchdog dumps every Python
+thread's stack, writes the abort key into the TCPStore (peers polling
+the same watchdog see it and raise instead of waiting out their own
+timeouts), and interrupts the main thread.
+
+    wd = enable_comm_watchdog(timeout=300, store=tcp_store)
+    with wd.watch("all_reduce"):          # or automatic via collectives
+        dist.all_reduce(x)
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+
+__all__ = [
+    "CommWatchdog", "enable_comm_watchdog", "disable_comm_watchdog",
+    "get_comm_watchdog", "CommTimeoutError",
+]
+
+ABORT_KEY = "__comm_abort__"
+
+
+class CommTimeoutError(RuntimeError):
+    pass
+
+
+class CommWatchdog:
+    def __init__(self, timeout=1800.0, store=None, rank=0,
+                 poll_interval=1.0, on_timeout=None):
+        self.timeout = float(timeout)
+        self.store = store
+        self.rank = rank
+        self._poll = poll_interval
+        self._on_timeout = on_timeout
+        self._active = {}      # id -> (tag, deadline)
+        self._next = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.fired = None      # (tag, why) after a trip
+        if store is not None:
+            try:  # a fresh watchdog must not trip on a PREVIOUS abort
+                store.delete_key(ABORT_KEY)
+            except Exception:
+                pass
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- registration ------------------------------------------------------
+    class _Scope:
+        def __init__(self, wd, tag, timeout):
+            self._wd = wd
+            self._tag = tag
+            self._timeout = timeout
+            self._id = None
+
+        def __enter__(self):
+            self._id = self._wd._register(self._tag, self._timeout)
+            return self
+
+        def __exit__(self, *exc):
+            self._wd._clear(self._id)
+            if exc[0] is None and self._wd.fired is not None:
+                tag, why = self._wd.fired
+                raise CommTimeoutError(
+                    f"communication watchdog fired during {tag!r}: {why}"
+                )
+            return False
+
+    def watch(self, tag, timeout=None):
+        return self._Scope(self, tag, timeout or self.timeout)
+
+    def _register(self, tag, timeout):
+        with self._lock:
+            wid = self._next
+            self._next += 1
+            self._active[wid] = (tag, time.time() + timeout)
+            return wid
+
+    def _clear(self, wid):
+        with self._lock:
+            self._active.pop(wid, None)
+
+    # -- the background loop ----------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self._poll):
+            now = time.time()
+            expired = None
+            with self._lock:
+                for tag, deadline in self._active.values():
+                    if now > deadline:
+                        expired = (tag, "local timeout")
+                        break
+            if expired is None and self.store is not None and self._active:
+                try:
+                    aborted = self.store.get(ABORT_KEY, wait=False)
+                except Exception:
+                    aborted = None
+                if aborted:
+                    expired = (
+                        "peer", f"abort propagated by {aborted}"
+                    )
+            if expired is not None:
+                self._trip(*expired)
+                return
+
+    def _trip(self, tag, why):
+        self.fired = (tag, why)
+        sys.stderr.write(
+            f"[comm_watchdog] rank {self.rank}: {tag!r} {why} "
+            f"(timeout={self.timeout}s) — thread stacks:\n"
+        )
+        for tid, frame in sys._current_frames().items():
+            sys.stderr.write(f"--- thread {tid} ---\n")
+            sys.stderr.write("".join(traceback.format_stack(frame)))
+        if self.store is not None and why == "local timeout":
+            try:  # propagate so peers abort instead of waiting
+                self.store.set(ABORT_KEY, f"rank{self.rank}:{tag}")
+            except Exception:
+                pass
+        if self._on_timeout is not None:
+            self._on_timeout(tag, why)
+        else:
+            import _thread
+
+            _thread.interrupt_main()
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+_singleton: CommWatchdog | None = None
+
+
+def enable_comm_watchdog(timeout=1800.0, store=None, rank=0, **kw):
+    """Install the process-wide watchdog; eager collectives
+    (distributed/communication.py) then run under watch scopes."""
+    global _singleton
+    if _singleton is not None:
+        _singleton.shutdown()
+    _singleton = CommWatchdog(timeout=timeout, store=store, rank=rank, **kw)
+    return _singleton
+
+
+def disable_comm_watchdog():
+    global _singleton
+    if _singleton is not None:
+        _singleton.shutdown()
+        _singleton = None
+
+
+def get_comm_watchdog():
+    return _singleton

@@ -84,6 +84,40 @@ def load_profiler_result(path):
     return path
 
 
+# -- op-level statistics (ref profiler_statistic.py) -------------------------
+# While a Profiler is in a RECORD state, core.dispatch times every eager
+# op (with block_until_ready, so device time lands on the op that spent
+# it — the profiling-overhead trade the reference's tracers make too) and
+# RecordEvent ranges accumulate here; Profiler.summary() renders the
+# aggregated table.
+
+_op_stats: dict | None = None
+
+
+def _stats_active():
+    return _op_stats is not None
+
+
+def _record_span(name, seconds, category="op"):
+    if _op_stats is None:
+        return
+    key = (category, name)
+    ent = _op_stats.get(key)
+    if ent is None:
+        _op_stats[key] = [1, seconds, seconds, seconds]
+    else:
+        ent[0] += 1
+        ent[1] += seconds
+        ent[2] = min(ent[2], seconds)
+        ent[3] = max(ent[3], seconds)
+
+
+def _set_dispatch_timer(on):
+    from ..core import dispatch
+
+    dispatch._prof_timer = _record_span if on else None
+
+
 class RecordEvent:
     """Host-side named range (ref profiler/utils.py:47). Shows up in the
     trace viewer as a TraceAnnotation span."""
@@ -104,6 +138,10 @@ class RecordEvent:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
         self.end_time = time.perf_counter()
+        if self.begin_time is not None:
+            _record_span(
+                self.name, self.end_time - self.begin_time, "user"
+            )
 
     def __enter__(self):
         self.begin()
@@ -162,10 +200,14 @@ class Profiler:
         return self
 
     def stop(self):
+        global _op_stats
         if self._tracing:
             self._stop_trace()
             if self._on_trace_ready is not None:
                 self._on_trace_ready(self)
+        if _op_stats is self.__dict__.get("_op_stats"):
+            _op_stats = None
+            _set_dispatch_timer(False)
         self.current_state = ProfilerState.CLOSED
 
     def step(self, num_samples=None):
@@ -179,9 +221,20 @@ class Profiler:
         self._maybe_transition(prev, self.current_state)
 
     def _maybe_transition(self, prev, state):
+        global _op_stats
         recording = state in (
             ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN
         )
+        if recording and _op_stats is None:
+            # accumulate across this profiler's record windows (repeating
+            # schedulers re-enter RECORD; stats must not reset per window)
+            _op_stats = self._op_stats = (
+                self.__dict__.get("_op_stats") or {}
+            )
+            _set_dispatch_timer(True)
+        elif not recording and _op_stats is self.__dict__.get("_op_stats"):
+            _op_stats = None
+            _set_dispatch_timer(False)
         if recording and not self._tracing and not self._timer_only:
             self._start_trace()
         elif not recording and self._tracing:
@@ -212,16 +265,53 @@ class Profiler:
     # -- reporting ---------------------------------------------------------
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        if not self._step_times:
+        """Step timing + the op-level statistic tables
+        (ref profiler_statistic.py: Overview + Operator Summary).
+        sorted_by: 'total' (default) | 'calls' | 'avg' | 'max'."""
+        if not self._step_times and not self.__dict__.get("_op_stats"):
             return "no steps recorded"
         ts = self._step_times
         unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
-        lines = [
-            "Profiler summary",
-            f"  steps: {len(ts)}",
-            f"  avg step: {sum(ts) / len(ts) * unit:.3f}{time_unit}",
-            f"  min/max: {min(ts) * unit:.3f}/{max(ts) * unit:.3f}{time_unit}",
-        ]
+        lines = ["Profiler summary"]
+        if ts:
+            lines += [
+                f"  steps: {len(ts)}",
+                f"  avg step: {sum(ts) / len(ts) * unit:.3f}{time_unit}",
+                f"  min/max: {min(ts) * unit:.3f}/"
+                f"{max(ts) * unit:.3f}{time_unit}",
+            ]
+        stats = self.__dict__.get("_op_stats") or {}
+        if op_detail and stats:
+            key_idx = {"total": 1, "calls": 0, "avg": None, "max": 3}
+            sk = sorted_by or "total"
+            grand = sum(v[1] for v in stats.values()) or 1.0
+
+            def sort_key(item):
+                (cat, name), v = item
+                if sk == "avg":
+                    return -(v[1] / v[0])
+                return -v[key_idx.get(sk, 1)]
+
+            for cat, title in (("op", "Operator Summary"),
+                               ("user", "UserDefined Summary")):
+                rows = [it for it in stats.items() if it[0][0] == cat]
+                if not rows:
+                    continue
+                lines.append(f"  -- {title} " + "-" * 40)
+                lines.append(
+                    f"  {'name':<28}{'calls':>7}{'total':>12}"
+                    f"{'avg':>12}{'max':>12}{'ratio':>8}"
+                )
+                for (c, name), (calls, tot, mn, mx) in sorted(
+                    rows, key=sort_key
+                ):
+                    lines.append(
+                        f"  {name[:27]:<28}{calls:>7}"
+                        f"{tot * unit:>11.3f}{time_unit:<1}"
+                        f"{tot / calls * unit:>11.3f}{time_unit:<1}"
+                        f"{mx * unit:>11.3f}{time_unit:<1}"
+                        f"{tot / grand * 100:>7.1f}%"
+                    )
         if self._log_dir:
             lines.append(f"  trace dir: {self._log_dir} (tensorboard --logdir)")
         out = "\n".join(lines)

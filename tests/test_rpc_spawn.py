@@ -1,0 +1,128 @@
+"""paddle.distributed.rpc + spawn.
+
+ref: python/paddle/distributed/rpc/rpc.py (init/sync/async/shutdown,
+tested multi-process like test/rpc/) and distributed/spawn.py.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    for k in list(env):
+        if k.startswith("PADDLE_"):
+            env.pop(k)
+    return env
+
+
+RPC_WORKER = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from paddle_tpu.distributed import rpc
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+
+def add(a, b):
+    return a + b
+
+def matsum(arr):
+    return float(np.asarray(arr).sum())
+
+def boom():
+    raise ValueError("remote boom")
+
+info = rpc.init_rpc(f"worker{rank}", rank=rank, world_size=2,
+                    master_endpoint=f"127.0.0.1:{port}")
+assert info.name == f"worker{rank}"
+assert len(rpc.get_all_worker_infos()) == 2
+if rank == 0:
+    peer = "worker1"
+    assert rpc.rpc_sync(peer, add, args=(2, 3)) == 5
+    fut = rpc.rpc_async(peer, matsum, args=(np.ones((4, 4)),))
+    assert fut.wait() == 16.0
+    try:
+        rpc.rpc_sync(peer, boom)
+        raise AssertionError("remote exception did not propagate")
+    except ValueError as e:
+        assert "remote boom" in str(e)
+    print("RPC_OK", flush=True)
+rpc.shutdown()
+"""
+
+
+class TestRPC:
+    def test_two_worker_rpc(self, tmp_path):
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        script = tmp_path / "w.py"
+        script.write_text(RPC_WORKER)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(r), str(port)],
+                env=_env(), stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+            )
+            for r in (0, 1)
+        ]
+        outs = [p.communicate(timeout=120)[0].decode() for p in procs]
+        assert procs[0].returncode == 0, outs[0]
+        assert procs[1].returncode == 0, outs[1]
+        assert "RPC_OK" in outs[0]
+
+
+SPAWN_WORKER = """
+import os
+import paddle_tpu.distributed as dist
+
+def train(rank_base, out_dir):
+    rank = dist.get_rank()
+    with open(os.path.join(out_dir, f"r{rank}.txt"), "w") as f:
+        f.write(f"{rank}/{dist.get_world_size()}")
+"""
+
+
+class TestSpawn:
+    def test_spawn_runs_nprocs(self, tmp_path):
+        from paddle_tpu.distributed import spawn
+
+        out = tmp_path / "out"
+        out.mkdir()
+
+        def fn(out_dir):
+            import os
+
+            import paddle_tpu.distributed as dist
+
+            rank = dist.get_rank()
+            with open(os.path.join(out_dir, f"r{rank}.txt"), "w") as f:
+                f.write(f"{rank}/{dist.get_world_size()}")
+
+        spawn(fn, args=(str(out),), nprocs=2)
+        got = sorted(p.name for p in out.iterdir())
+        assert got == ["r0.txt", "r1.txt"]
+        assert (out / "r0.txt").read_text() == "0/2"
+        assert (out / "r1.txt").read_text() == "1/2"
+
+    def test_spawn_propagates_failure(self, tmp_path):
+        from paddle_tpu.distributed import spawn
+
+        def bad():
+            raise RuntimeError("worker died")
+
+        with pytest.raises(Exception, match="worker died|exit"):
+            spawn(bad, nprocs=2)

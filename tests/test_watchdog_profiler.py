@@ -1,0 +1,142 @@
+"""Comm watchdog (hung-collective detection + store-propagated abort)
+and profiler op-statistic tables.
+
+ref: phi/core/distributed/comm_task_manager.h:37 / nccl_comm_task.cc
+(watchdog) and python profiler_statistic.py (op summary tables).
+"""
+import socket
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.ops as F
+from paddle_tpu.distributed import TCPStore
+from paddle_tpu.distributed.watchdog import (
+    ABORT_KEY,
+    CommTimeoutError,
+    CommWatchdog,
+    disable_comm_watchdog,
+    enable_comm_watchdog,
+    get_comm_watchdog,
+)
+
+
+def _port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class TestWatchdog:
+    def test_fast_op_passes_clean(self):
+        fired = []
+        wd = CommWatchdog(timeout=5, on_timeout=lambda t, w: fired.append(t))
+        with wd.watch("quick"):
+            time.sleep(0.05)
+        wd.shutdown()
+        assert not fired and wd.fired is None
+
+    def test_hang_fires_and_raises(self):
+        fired = []
+        wd = CommWatchdog(
+            timeout=0.3, poll_interval=0.05,
+            on_timeout=lambda t, w: fired.append((t, w)),
+        )
+        with pytest.raises(CommTimeoutError, match="slow_collective"):
+            with wd.watch("slow_collective"):
+                time.sleep(1.0)  # "hung" op
+        assert fired and fired[0][0] == "slow_collective"
+        wd.shutdown()
+
+    def test_abort_propagates_through_store(self):
+        port = _port()
+        master = TCPStore("127.0.0.1", port, is_master=True, timeout=10)
+        peer_store = TCPStore("127.0.0.1", port, timeout=10)
+        fired_a, fired_b = [], []
+        # rank 0 hangs and times out; rank 1 is inside a healthy-but-
+        # waiting op and gets the propagated abort
+        wd_a = CommWatchdog(timeout=0.3, poll_interval=0.05, store=master,
+                            rank=0, on_timeout=lambda t, w: fired_a.append(w))
+        wd_b = CommWatchdog(timeout=30, poll_interval=0.05,
+                            store=peer_store, rank=1,
+                            on_timeout=lambda t, w: fired_b.append(w))
+        try:
+            with pytest.raises(CommTimeoutError):
+                with wd_a.watch("all_reduce"):
+                    time.sleep(0.8)
+            with pytest.raises(CommTimeoutError, match="propagated"):
+                with wd_b.watch("all_reduce"):
+                    deadline = time.time() + 5
+                    while wd_b.fired is None and time.time() < deadline:
+                        time.sleep(0.05)
+            assert fired_a == ["local timeout"]
+            assert fired_b and "rank0" in fired_b[0]
+            assert master.get(ABORT_KEY).startswith("rank0")
+        finally:
+            wd_a.shutdown()
+            wd_b.shutdown()
+            peer_store.close()
+            master.close()
+
+    def test_collectives_run_under_enabled_watchdog(self):
+        import paddle_tpu.distributed as dist
+
+        enable_comm_watchdog(timeout=30)
+        try:
+            assert get_comm_watchdog() is not None
+            x = paddle.to_tensor(
+                np.arange(8, dtype="float32").reshape(8, 1)
+            )
+            out = dist.all_reduce(x)
+            np.testing.assert_allclose(out.numpy()[0], [28.0])
+        finally:
+            disable_comm_watchdog()
+        assert get_comm_watchdog() is None
+
+
+class TestProfilerStats:
+    def test_op_table_collects_and_prints(self):
+        from paddle_tpu import profiler
+
+        with profiler.Profiler(timer_only=True) as p:
+            a = paddle.to_tensor(np.random.rand(64, 64).astype("float32"))
+            for _ in range(3):
+                b = F.matmul(a, a)
+                c = F.relu(b)
+            with profiler.RecordEvent("my_region"):
+                F.softmax(c, -1)
+            p.step()
+        out = p.summary(time_unit="us")
+        assert "Operator Summary" in out
+        assert "matmul" in out and "relu" in out
+        assert "UserDefined Summary" in out and "my_region" in out
+        # counts: matmul ran 3x
+        row = next(ln for ln in out.splitlines() if "matmul" in ln)
+        assert "3" in row.split()[1]
+
+    def test_sorted_by_calls(self):
+        from paddle_tpu import profiler
+
+        with profiler.Profiler(timer_only=True) as p:
+            a = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
+            for _ in range(5):
+                F.relu(a)
+            F.matmul(a, a)
+            p.step()
+        out = p.summary(sorted_by="calls")
+        lines = [ln for ln in out.splitlines()
+                 if "relu" in ln or "matmul" in ln]
+        assert "relu" in lines[0]  # most calls first
+
+    def test_stats_cleared_after_stop(self):
+        from paddle_tpu import profiler
+        from paddle_tpu.core import dispatch
+
+        with profiler.Profiler(timer_only=True):
+            F.relu(paddle.to_tensor(np.zeros((2,), "float32")))
+        assert dispatch._prof_timer is None
+        assert profiler._op_stats is None
