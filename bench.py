@@ -212,7 +212,12 @@ def bench_moe(paddle, on_tpu, peak):
         _, loss = m(ids, labels=ids)
         return loss
 
-    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    # donate=False: buffer donation for the expert-stacked params is
+    # rejected/round-tripped by the remote-AOT tunnel and costs ~19s/step
+    # (measured: donate=True 19.1s vs donate=False 0.16s on the 2-layer
+    # probe); without donation the old+new state transiently coexists
+    # (~2x state bytes), which the shrink ladder accounts for
+    step = paddle.jit.TrainStep(model, loss_fn, opt, donate=False)
     batch, seq = (batch_l, 1024) if on_tpu else (2, 32)
     ids = paddle.to_tensor(
         np.random.RandomState(0).randint(

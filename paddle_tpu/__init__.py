@@ -75,6 +75,7 @@ from .autograd import grad  # noqa: E402  (needs patched Tensor)
 from . import amp  # noqa: E402
 from . import audio  # noqa: E402
 from . import text  # noqa: E402
+from . import utils  # noqa: E402
 from . import autograd  # noqa: E402
 from . import framework  # noqa: E402
 from . import device  # noqa: E402

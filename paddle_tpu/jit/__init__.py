@@ -15,6 +15,7 @@ Two entry points:
     so updates happen in-place in device memory.
 """
 from .api import StaticFunction, TrainStep, ignore_module, not_to_static, to_static
+from .bucketing import BucketedFunction, bucketize
 from .serialization import InputSpec, TranslatedLayer, load, save
 
 __all__ = [
@@ -27,4 +28,6 @@ __all__ = [
     "load",
     "InputSpec",
     "TranslatedLayer",
+    "bucketize",
+    "BucketedFunction",
 ]

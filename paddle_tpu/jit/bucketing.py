@@ -1,0 +1,128 @@
+"""Input bucketing: the recompile-avoidance policy for dynamic shapes.
+
+ref: the reference compiles dynamic shapes symbolically (pir DimExpr,
+pir/include/dialect/shape/utils/dim_expr.h + ShapeConstraintIRAnalysis);
+XLA's dynamic-dimension support is too limited for that design, so per
+SURVEY §7 step 3 the TPU-native policy is PADDING TO BUCKETS: variable
+dims are padded up to a small set of bucket sizes, giving one compiled
+program per bucket instead of one per shape (the standard TPU serving
+recipe for variable batch/sequence).
+
+    fn = paddle.jit.bucketize(model_fn, buckets={0: [8, 16, 32]})
+    fn(x_batch_13)   # pads dim 0 to 16; at most len(buckets) compiles
+
+Outputs whose padded dimension survives to the output are sliced back to
+the true size (tracked per call). Padding is zeros; reductions over the
+padded axis are the CALLER's responsibility to mask (same contract as
+any padded batch).
+
+Slice-back is a size heuristic: an output dim equal to the padded target
+is sliced to the true size (unpadded INPUT tensors passed through
+unchanged are exempted by identity). An output that coincidentally has
+the bucket size on a bucketed dim (e.g. a returned weight of shape
+[bucket, k]) would be mis-sliced — return such values outside the
+bucketed function.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+__all__ = ["bucketize", "BucketedFunction"]
+
+
+def _next_bucket(size, buckets):
+    for b in buckets:
+        if size <= b:
+            return b
+    raise ValueError(
+        f"size {size} exceeds the largest bucket {buckets[-1]}; add a "
+        "bigger bucket"
+    )
+
+
+class BucketedFunction:
+    def __init__(self, fn, buckets, pad_value=0):
+        self._fn = fn
+        self._buckets = {
+            int(d): sorted(int(b) for b in bs) for d, bs in buckets.items()
+        }
+        self._pad_value = pad_value
+        self.signatures = set()  # distinct padded signatures seen
+
+    def __call__(self, *args, **kwargs):
+        from .. import ops as F
+
+        slice_back = {}   # dim -> (padded, original)
+        passthrough = []  # unpadded input tensors: never slice these
+
+        def pad(x):
+            if not isinstance(x, Tensor):
+                return x
+            pads_needed = False
+            widths = []
+            for d in range(x.ndim):
+                bs = self._buckets.get(d)
+                if bs is None or x.shape[d] in bs:
+                    widths.append((0, 0))
+                    continue
+                target = _next_bucket(x.shape[d], bs)
+                widths.append((0, target - x.shape[d]))
+                slice_back[d] = (target, x.shape[d])
+                pads_needed = True
+            if not pads_needed:
+                passthrough.append(x)
+                return x
+            flat = [w for pair in widths for w in pair]
+            # widths are in leading-dim order (F.pad defaults to the
+            # torch-style last-dim-first convention)
+            return F.pad(
+                x, flat, value=self._pad_value, pad_from_last_axis=False
+            )
+
+        import jax
+
+        is_t = lambda v: isinstance(v, Tensor)  # noqa: E731
+        args = jax.tree_util.tree_map(pad, args, is_leaf=is_t)
+        kwargs = jax.tree_util.tree_map(pad, kwargs, is_leaf=is_t)
+        self.signatures.add(
+            tuple(
+                (tuple(v.shape), str(v.dtype))
+                for v in jax.tree_util.tree_leaves(
+                    (args, kwargs), is_leaf=is_t
+                )
+                if isinstance(v, Tensor)
+            )
+        )
+        out = self._fn(*args, **kwargs)
+
+        def unpad(y):
+            if not isinstance(y, Tensor):
+                return y
+            if any(y is t for t in passthrough):
+                return y  # an unpadded input flowed straight through
+            idx = []
+            changed = False
+            for d in range(y.ndim):
+                pb = slice_back.get(d)
+                if pb and y.shape[d] == pb[0] and pb[0] != pb[1]:
+                    idx.append(slice(0, pb[1]))
+                    changed = True
+                else:
+                    idx.append(slice(None))
+            return F.getitem(y, tuple(idx)) if changed else y
+
+        return jax.tree_util.tree_map(unpad, out, is_leaf=is_t)
+
+
+def bucketize(function=None, buckets=None, pad_value=0):
+    """Wrap ``function`` (a plain callable or a to_static StaticFunction)
+    with the bucket-padding policy. ``buckets``: {tensor_dim: [sizes]}."""
+    if buckets is None:
+        raise ValueError("bucketize requires buckets={dim: [sizes]}")
+
+    def wrap(fn):
+        return BucketedFunction(fn, buckets, pad_value)
+
+    if function is not None:
+        return wrap(function)
+    return wrap

@@ -1,0 +1,4 @@
+from . import cpp_extension  # noqa: F401
+from .cpp_extension import load, register_custom_op  # noqa: F401
+
+__all__ = ["cpp_extension", "load", "register_custom_op"]

@@ -1,0 +1,79 @@
+"""Input bucketing (the DimExpr-replacement recompile-avoidance policy).
+
+ref: pir symbolic shapes (dim_expr.h) -> SURVEY §7 step 3 padding policy.
+Pin: bounded compile count across varying shapes, correct unpadded
+results, slice-back of surviving padded dims.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.ops as F
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, "float32"))
+
+
+class TestBucketize:
+    def test_bounded_compiles_across_batch_sizes(self):
+        traces = [0]
+
+        def fn(x):
+            traces[0] += 1
+            return F.relu(x) * 2.0
+
+        staged = paddle.jit.to_static(fn)
+        bucketed = paddle.jit.bucketize(staged, buckets={0: [4, 8, 16]})
+        for n in (3, 4, 5, 7, 9, 13, 2, 6):
+            out = bucketed(_t(np.ones((n, 2))))
+            assert out.shape == [n, 2]  # sliced back to true size
+            np.testing.assert_allclose(out.numpy(), np.full((n, 2), 2.0))
+        # 8 different shapes, at most 3 buckets -> at most 3 traces
+        assert traces[0] <= 3
+        assert len(bucketed.signatures) <= 3
+
+    def test_second_dim_bucketing(self):
+        bucketed = paddle.jit.bucketize(
+            lambda x: x + 1.0, buckets={1: [8, 32]}
+        )
+        out = bucketed(_t(np.zeros((2, 5))))
+        assert out.shape == [2, 5]
+        np.testing.assert_allclose(out.numpy(), np.ones((2, 5)))
+
+    def test_oversize_raises(self):
+        bucketed = paddle.jit.bucketize(
+            lambda x: x, buckets={0: [4]}
+        )
+        with pytest.raises(ValueError, match="largest bucket"):
+            bucketed(_t(np.zeros((9, 1))))
+
+    def test_reduced_output_not_sliced(self):
+        # output lost the bucketed dim (sum over it): nothing to slice
+        bucketed = paddle.jit.bucketize(
+            lambda x: F.sum(x, axis=0), buckets={0: [8]}
+        )
+        out = bucketed(_t(np.ones((5, 3))))
+        assert out.shape == [3]
+        # zero padding + sum over padded axis stays exact
+        np.testing.assert_allclose(out.numpy(), np.full((3,), 5.0))
+
+    def test_exact_bucket_size_no_pad(self):
+        bucketed = paddle.jit.bucketize(
+            lambda x: x * 3.0, buckets={0: [4, 8]}
+        )
+        out = bucketed(_t(np.ones((8, 2))))
+        assert out.shape == [8, 2]
+        np.testing.assert_allclose(out.numpy(), np.full((8, 2), 3.0))
+
+    def test_unpadded_passthrough_input_not_sliced(self):
+        # an input already AT bucket size returned as-is must not be
+        # sliced by another input's padding (identity exemption)
+        bucketed = paddle.jit.bucketize(
+            lambda a, b: (F.sum(a, axis=1), b), buckets={0: [16]}
+        )
+        a = _t(np.ones((13, 2)))
+        b = _t(np.ones((16, 2)))
+        sa, sb = bucketed(a, b)
+        assert sa.shape == [13]
+        assert sb.shape == [16, 2]
