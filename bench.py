@@ -200,6 +200,10 @@ def bench_moe(paddle, on_tpu, peak):
     )
     kw.update(lv)  # level overrides (level 3 shrinks h/ffn/heads too)
     cfg = LlamaConfig(**kw) if on_tpu else LlamaConfig.tiny(num_experts=4)
+    if on_tpu:
+        # same flash gate as the llama row: unflashed seq-1024 attention
+        # stashes [b, h, s, s] scores per layer for bwd and thrashes HBM
+        paddle.set_flags({"FLAGS_flash_attention_min_seq": 1024})
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     model.bfloat16()

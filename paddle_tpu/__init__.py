@@ -76,6 +76,7 @@ from . import amp  # noqa: E402
 from . import audio  # noqa: E402
 from . import text  # noqa: E402
 from . import utils  # noqa: E402
+from . import inference  # noqa: E402
 from . import autograd  # noqa: E402
 from . import framework  # noqa: E402
 from . import device  # noqa: E402

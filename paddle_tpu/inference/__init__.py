@@ -1,0 +1,151 @@
+"""paddle.inference analogue: Config + Predictor over saved artifacts.
+
+ref: paddle/fluid/inference/api/analysis_predictor.cc (+ paddle_infer
+python API paddle/inference/__init__.py: Config, create_predictor,
+predictor.get_input_names/get_input_handle/run). The reference's
+predictor owns a pass-optimized program + zero-copy IO tensors; here a
+jit-saved TranslatedLayer (StableHLO-exported program) is the artifact
+and XLA the optimizer, so the Predictor is a thin serving wrapper:
+named numpy IO, one compiled executable per input signature, batch-size
+bucketing optional via jit.bucketize.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """ref inference Config: model path + tuning knobs. TPU-native: the
+    device/ir-optim/TensorRT knobs of the reference collapse into XLA;
+    kept fields are the model location and bucketing policy."""
+
+    def __init__(self, model_path=None, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+        self._buckets = None
+
+    # API-parity knobs (accepted, their work is XLA's)
+    def enable_memory_optim(self, *a, **k):
+        return None
+
+    def switch_ir_optim(self, *a, **k):
+        return None
+
+    def set_cpu_math_library_num_threads(self, *a, **k):
+        return None
+
+    def enable_xpu(self, *a, **k):
+        return None
+
+    def set_batch_buckets(self, dim_to_sizes):
+        """TPU-native knob: pad variable dims to buckets so serving
+        compiles a bounded program set (jit/bucketing.py)."""
+        self._buckets = dict(dim_to_sizes)
+
+
+class _IOHandle:
+    """Zero-copy-style IO handle (ref ZeroCopyTensor): named slot the
+    caller fills/reads with numpy."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.asarray(arr)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self):
+        return self._value
+
+
+class Predictor:
+    """ref analysis_predictor.cc. Load once, then:
+
+        p = create_predictor(Config("model_dir/model"))
+        p.get_input_handle(p.get_input_names()[0]).copy_from_cpu(x)
+        p.run()
+        out = p.get_output_handle(p.get_output_names()[0]).copy_to_cpu()
+
+    or the functional form: ``outs = p(x, y)``.
+    """
+
+    def __init__(self, config: Config):
+        from ..jit.serialization import load as jit_load
+
+        self._layer = jit_load(config.model_path)
+        fn = self._layer
+        if config._buckets:
+            from ..jit.bucketing import BucketedFunction
+
+            fn = BucketedFunction(self._layer, config._buckets)
+        self._fn = fn
+        try:
+            spec = self._layer.input_spec
+        except Exception:
+            spec = None
+        self._in_names = (
+            [getattr(s, "name", None) or f"input_{i}"
+             for i, s in enumerate(spec)]
+            if spec else ["input_0"]
+        )
+        self._inputs = {n: _IOHandle(n) for n in self._in_names}
+        self._out_names = []
+        self._outputs = {}
+
+    # -- named-handle API --------------------------------------------------
+    def get_input_names(self):
+        return list(self._in_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return list(self._out_names)
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self):
+        args = []
+        for n in self._in_names:
+            v = self._inputs[n]._value
+            if v is None:
+                raise ValueError(f"input {n!r} was not set")
+            args.append(Tensor(v))
+        outs = self._fn(*args)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        self._out_names = [f"output_{i}" for i in range(len(outs))]
+        self._outputs = {}
+        for n, o in zip(self._out_names, outs):
+            h = _IOHandle(n)
+            h._value = (
+                np.asarray(o.numpy()) if isinstance(o, Tensor)
+                else np.asarray(o)
+            )
+            self._outputs[n] = h
+        return True
+
+    # -- functional form ---------------------------------------------------
+    def __call__(self, *arrays):
+        if len(arrays) != len(self._in_names):
+            raise ValueError(
+                f"predictor expects {len(self._in_names)} inputs "
+                f"({self._in_names}), got {len(arrays)}"
+            )
+        for n, a in zip(self._in_names, arrays):
+            self._inputs[n].copy_from_cpu(a)
+        self.run()
+        return [self._outputs[n].copy_to_cpu() for n in self._out_names]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

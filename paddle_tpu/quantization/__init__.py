@@ -16,6 +16,8 @@ from ..nn.layer.layers import Layer
 __all__ = [
     "QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "FakeQuanterWithAbsMax",
     "quant_dequant",
+    "PerChannelAbsmaxObserver", "EMAObserver",
+    "weight_quantize", "weight_dequantize", "quantize_weights",
 ]
 
 
@@ -234,3 +236,110 @@ class PTQ:
 
         swap(model)
         return model
+
+
+class PerChannelAbsmaxObserver(Layer):
+    """Per-output-channel absmax calibration (ref:
+    quantization/observers/abs_max_headwise.py / channel-wise observers):
+    one scale per slice along ``quant_axis``."""
+
+    def __init__(self, quant_bits=8, quant_axis=-1):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.quant_axis = quant_axis
+        self._absmax = None
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        axis = self.quant_axis % x.ndim
+        reduce_axes = tuple(d for d in range(x.ndim) if d != axis)
+        cur = jnp.max(jnp.abs(x._data), axis=reduce_axes)
+        self._absmax = (
+            cur if self._absmax is None
+            else jnp.maximum(self._absmax, cur)
+        )
+        return x
+
+    def scale(self):
+        import jax.numpy as jnp
+
+        if self._absmax is None:
+            raise RuntimeError("observer has seen no data")
+        return Tensor(jnp.maximum(self._absmax, 1e-8))
+
+
+class EMAObserver(Layer):
+    """Moving-average absmax (ref: quantization/observers/ema.py —
+    activation ranges smoothed across calibration batches)."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self._ema = None
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        cur = float(jnp.max(jnp.abs(x._data)))
+        self._ema = (
+            cur if self._ema is None
+            else self.moving_rate * self._ema
+            + (1 - self.moving_rate) * cur
+        )
+        return x
+
+    def scale(self):
+        if self._ema is None:
+            raise RuntimeError("observer has seen no data")
+        return Tensor(np.asarray(max(self._ema, 1e-8), np.float32))
+
+
+def weight_quantize(w, bits=8, quant_axis=-1):
+    """Real int8 weight quantization (the deployment path; ref
+    quantization int8 export): returns (int8 weights, per-channel fp32
+    scales along quant_axis)."""
+    import jax.numpy as jnp
+
+    arr = w._data if isinstance(w, Tensor) else jnp.asarray(w)
+    axis = quant_axis % arr.ndim
+    reduce_axes = tuple(d for d in range(arr.ndim) if d != axis)
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(arr), axis=reduce_axes, keepdims=True), 1e-8
+    )
+    q = jnp.clip(jnp.round(arr / scale * qmax), -qmax, qmax).astype(
+        jnp.int8
+    )
+    return Tensor(q), Tensor(jnp.squeeze(scale, reduce_axes) / qmax)
+
+
+def weight_dequantize(q, scale, quant_axis=-1):
+    """Inverse of weight_quantize."""
+    import jax.numpy as jnp
+
+    qa = q._data if isinstance(q, Tensor) else jnp.asarray(q)
+    sa = scale._data if isinstance(scale, Tensor) else jnp.asarray(scale)
+    axis = quant_axis % qa.ndim
+    shape = [1] * qa.ndim
+    shape[axis] = qa.shape[axis]
+    return Tensor(qa.astype(jnp.float32) * sa.reshape(shape))
+
+
+def quantize_weights(model, bits=8, layer_types=("Linear",)):
+    """Weight-only int8 deployment conversion: every matching layer's
+    weight is replaced by dequantize(int8(w)) (the serving memory win;
+    XLA folds the dequant into the matmul). Returns
+    {layer_name: (int8_weights, scales)} for export."""
+    out = {}
+    for name, sub in model.named_sublayers(include_self=True):
+        if type(sub).__name__ not in layer_types:
+            continue
+        w = getattr(sub, "weight", None)
+        if w is None or w.ndim < 2:
+            continue
+        q, s = weight_quantize(w, bits=bits)
+        w._rebind(weight_dequantize(q, s)._data.astype(w._data.dtype))
+        out[name or "root"] = (q, s)
+    return out

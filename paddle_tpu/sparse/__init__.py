@@ -19,7 +19,12 @@ from ..core.tensor import Tensor
 
 __all__ = [
     "SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor",
-    "is_sparse", "matmul", "add", "relu",
+    "is_sparse", "matmul", "add", "subtract", "multiply", "divide",
+    "relu", "coalesce", "transpose", "sum", "is_same_shape", "mask_as",
+    # value-elementwise unary family (ref sparse/unary.py)
+    "sin", "tan", "asin", "atan", "sinh", "asinh", "atanh", "tanh",
+    "sqrt", "square", "log1p", "expm1", "abs", "neg", "pow", "cast",
+    "rad2deg", "deg2rad", "nn",
 ]
 
 
@@ -141,3 +146,192 @@ def relu(x):
     return SparseCooTensor(
         jsparse.BCOO((jnp.maximum(b.data, 0), b.indices), shape=b.shape)
     )
+
+
+# -- value-elementwise unary family (ref sparse/unary.py) --------------------
+# Zero-preserving maps apply to the stored values only — the reference
+# implements each as a dedicated sparse kernel (phi/kernels/sparse/unary);
+# here one table over BCOO values.
+
+
+def _unary(name, fn):
+    def op(x, name=None):
+        if not isinstance(x, SparseCooTensor):
+            raise TypeError(f"sparse.{name} expects a SparseCooTensor")
+        b = x._bcoo
+        return SparseCooTensor(
+            jsparse.BCOO((fn(b.data), b.indices), shape=b.shape)
+        )
+
+    op.__name__ = name
+    op.__doc__ = f"sparse.{name} (ref sparse/unary.py:{name})"
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+tanh = _unary("tanh", jnp.tanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+expm1 = _unary("expm1", jnp.expm1)
+abs = _unary("abs", jnp.abs)  # noqa: A001
+neg = _unary("neg", jnp.negative)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    """ref sparse/unary.py:pow — values ** factor."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.pow expects a SparseCooTensor")
+    b = x._bcoo
+    return SparseCooTensor(
+        jsparse.BCOO((b.data ** factor, b.indices), shape=b.shape)
+    )
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """ref sparse/unary.py:cast."""
+    from ..core.dtype import convert_dtype
+
+    b = x._bcoo
+    data, idx = b.data, b.indices
+    if value_dtype is not None:
+        data = data.astype(convert_dtype(value_dtype).jnp_dtype)
+    if index_dtype is not None:
+        idx = idx.astype(convert_dtype(index_dtype).jnp_dtype)
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=b.shape))
+
+
+def coalesce(x, name=None):
+    """Merge duplicate indices (ref sparse/unary.py:coalesce)."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.coalesce expects a SparseCooTensor")
+    return SparseCooTensor(jsparse.bcoo_sum_duplicates(x._bcoo))
+
+
+def transpose(x, perm, name=None):
+    """ref sparse/unary.py:transpose."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.transpose expects a SparseCooTensor")
+    return SparseCooTensor(
+        jsparse.bcoo_transpose(x._bcoo, permutation=tuple(perm))
+    )
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """ref sparse/unary.py:sum — returns a DENSE Tensor (the reference
+    returns sparse for some axes; dense is the XLA-honest result of a
+    contraction)."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.sum expects a SparseCooTensor")
+    dense = x._bcoo.todense()
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+
+        out = out.astype(convert_dtype(dtype).jnp_dtype)
+    return Tensor(out)
+
+
+def is_same_shape(x, y):
+    """ref sparse/unary.py helper."""
+    return list(x.shape) == list(y.shape)
+
+
+def mask_as(x, mask, name=None):
+    """Keep x's entries at the mask's sparsity pattern
+    (ref sparse/binary.py mask_as)."""
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    b = mask._bcoo
+    vals = xa[tuple(b.indices[:, d] for d in range(b.indices.shape[1]))]
+    return SparseCooTensor(
+        jsparse.BCOO((vals, b.indices), shape=b.shape)
+    )
+
+
+def _check_pair(name, x, y):
+    if not (isinstance(x, SparseCooTensor)
+            and isinstance(y, SparseCooTensor)):
+        raise TypeError(f"sparse.{name} expects two SparseCooTensors")
+    if list(x.shape) != list(y.shape):
+        raise ValueError(f"sparse.{name}: shape mismatch")
+
+
+def subtract(x, y, name=None):
+    """ref sparse/binary.py:subtract — O(nnz) union-of-supports path
+    (add of the negation, like add())."""
+    _check_pair("subtract", x, y)
+    return add(x, neg(y))
+
+
+def multiply(x, y, name=None):
+    """ref sparse/binary.py:multiply. Densifies internally (XLA lowers
+    the elementwise product over dense intermediates); the support of
+    the result is the intersection, so fromdense re-sparsifies."""
+    _check_pair("multiply", x, y)
+    out = jnp.multiply(x._bcoo.todense(), y._bcoo.todense())
+    return SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+
+def divide(x, y, name=None):
+    """ref sparse/binary.py:divide. Defined on x's support only —
+    off-support positions stay exact zeros (a naive dense divide would
+    store 0/0 NaNs everywhere off-support). Densifies internally."""
+    _check_pair("divide", x, y)
+    xd = x._bcoo.todense()
+    yd = y._bcoo.todense()
+    support = jnp.zeros(x._bcoo.shape, bool).at[
+        tuple(x._bcoo.indices[:, d]
+              for d in range(x._bcoo.indices.shape[1]))
+    ].set(True)
+    out = jnp.where(support, xd / jnp.where(support, yd, 1.0), 0.0)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+
+class _SparseNN:
+    """sparse.nn shim: ReLU layer (ref sparse/nn/layer/activation.py)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    class Softmax:
+        """Row-wise softmax over the stored values of a 2-D COO
+        (ref sparse/nn/layer/activation.py Softmax: softmax over
+        non-zero entries per row)."""
+
+        def __call__(self, x):
+            b = jsparse.bcoo_sum_duplicates(x._bcoo)
+            if len(b.shape) < 2:
+                raise ValueError("sparse Softmax needs ndim >= 2")
+            # group by ALL leading dims (a 3-D [B, R, C] normalizes per
+            # [b, r] row, not per batch slice): flatten leading indices
+            # to scalar row keys via strides
+            strides = np.cumprod(
+                (list(b.shape[1:-1]) + [1])[::-1]
+            )[::-1].tolist()
+            # (module-level `sum` is the sparse op — accumulate manually)
+            rows = b.indices[:, 0] * int(strides[0])
+            for d in range(1, len(b.shape) - 1):
+                rows = rows + b.indices[:, d] * int(strides[d])
+            vals = b.data.astype(jnp.float32)
+            n_rows = int(np.prod(b.shape[:-1]))
+            row_max = jnp.full((n_rows,), -jnp.inf).at[rows].max(vals)
+            e = jnp.exp(vals - row_max[rows])
+            denom = jnp.zeros((n_rows,)).at[rows].add(e)
+            return SparseCooTensor(
+                jsparse.BCOO(
+                    ((e / denom[rows]).astype(b.data.dtype), b.indices),
+                    shape=b.shape,
+                )
+            )
+
+
+nn = _SparseNN()

@@ -156,3 +156,36 @@ class TestDiT:
         np.testing.assert_allclose(
             blk(x, c).numpy(), x.numpy(), atol=1e-6
         )
+
+
+class TestPredictor:
+    def test_config_create_run(self, tmp_path):
+        """ref inference API flow: save -> Config -> create_predictor ->
+        named handles -> run (analysis_predictor.cc UX)."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.inference import Config, create_predictor
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(3, 4).astype("float32"))
+        ref = net(x).numpy()
+        path = str(tmp_path / "m")
+        paddle.jit.save(
+            net, path,
+            input_spec=[paddle.static.InputSpec([3, 4], "float32", "x")],
+        )
+        pred = create_predictor(Config(path))
+        names = pred.get_input_names()
+        assert names and isinstance(names[0], str)
+        pred.get_input_handle(names[0]).copy_from_cpu(x.numpy())
+        assert pred.run()
+        out = pred.get_output_handle(
+            pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        # functional form
+        outs = pred(x.numpy())
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)

@@ -145,3 +145,153 @@ class TestReviewRegressions:
                                 inplace=True)
         m(paddle.to_tensor(np.ones((2, 4), np.float32)))
         assert calls  # custom quanter invoked
+
+
+class TestSparseWidened:
+    def _coo(self):
+        import paddle_tpu.sparse as sp
+
+        idx = np.array([[0, 0, 1, 2], [0, 2, 1, 0]])
+        vals = np.array([1.0, -2.0, 3.0, 0.5], "float32")
+        return sp.sparse_coo_tensor(idx, vals, (3, 3)), idx, vals
+
+    def test_unary_family_on_values(self):
+        import paddle_tpu.sparse as sp
+
+        x, idx, vals = self._coo()
+        for name, ref in [("tanh", np.tanh), ("square", np.square),
+                          ("abs", np.abs), ("neg", np.negative),
+                          ("expm1", np.expm1), ("sin", np.sin)]:
+            out = getattr(sp, name)(x)
+            dense = np.zeros((3, 3), "float32")
+            dense[idx[0], idx[1]] = ref(vals)
+            np.testing.assert_allclose(
+                out.to_dense().numpy(), dense, rtol=1e-6, atol=1e-6
+            )
+
+    def test_transpose_sum_coalesce(self):
+        import paddle_tpu.sparse as sp
+
+        x, idx, vals = self._coo()
+        t = sp.transpose(x, [1, 0])
+        np.testing.assert_allclose(
+            t.to_dense().numpy(), x.to_dense().numpy().T
+        )
+        np.testing.assert_allclose(
+            sp.sum(x, axis=1).numpy(), x.to_dense().numpy().sum(1)
+        )
+        dup = sp.sparse_coo_tensor(
+            np.array([[0, 0], [1, 1]]), np.array([2.0, 3.0], "float32"),
+            (2, 2),
+        )
+        c = sp.coalesce(dup)
+        assert c.to_dense().numpy()[0, 1] == 5.0
+
+    def test_binary_and_mask(self):
+        import paddle_tpu.sparse as sp
+
+        x, idx, vals = self._coo()
+        m = sp.multiply(x, x)
+        np.testing.assert_allclose(
+            m.to_dense().numpy(), x.to_dense().numpy() ** 2
+        )
+        dense = np.arange(9, dtype="float32").reshape(3, 3)
+        masked = sp.mask_as(paddle.to_tensor(dense), x)
+        want = np.zeros((3, 3), "float32")
+        want[idx[0], idx[1]] = dense[idx[0], idx[1]]
+        np.testing.assert_allclose(masked.to_dense().numpy(), want)
+
+    def test_sparse_softmax_rows(self):
+        import paddle_tpu.sparse as sp
+
+        x, idx, vals = self._coo()
+        out = sp.nn.Softmax()(x).to_dense().numpy()
+        # row 0 has entries at cols 0, 2: softmax over those two
+        e = np.exp([1.0 - 1.0, -2.0 - 1.0])
+        np.testing.assert_allclose(
+            [out[0, 0], out[0, 2]], e / e.sum(), rtol=1e-5
+        )
+        np.testing.assert_allclose(out[1, 1], 1.0, rtol=1e-6)
+
+
+class TestQuantWidened:
+    def test_per_channel_observer(self):
+        from paddle_tpu.quantization import PerChannelAbsmaxObserver
+
+        ob = PerChannelAbsmaxObserver(quant_axis=1)
+        ob(paddle.to_tensor(np.array([[1.0, -4.0], [2.0, 3.0]], "float32")))
+        np.testing.assert_allclose(ob.scale().numpy(), [2.0, 4.0])
+
+    def test_ema_observer_smooths(self):
+        from paddle_tpu.quantization import EMAObserver
+
+        ob = EMAObserver(moving_rate=0.5)
+        ob(paddle.to_tensor(np.array([4.0], "float32")))
+        ob(paddle.to_tensor(np.array([8.0], "float32")))
+        np.testing.assert_allclose(float(ob.scale().numpy()), 6.0)
+
+    def test_weight_quantize_roundtrip(self):
+        from paddle_tpu.quantization import (
+            weight_dequantize,
+            weight_quantize,
+        )
+
+        w = paddle.to_tensor(
+            np.random.RandomState(0).randn(16, 8).astype("float32"))
+        q, s = weight_quantize(w, bits=8)
+        assert str(q.dtype).endswith("int8")
+        back = weight_dequantize(q, s)
+        err = np.abs(back.numpy() - w.numpy()).max()
+        assert err < np.abs(w.numpy()).max() / 100  # 8-bit fidelity
+
+    def test_quantize_weights_model(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.quantization import quantize_weights
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 8).astype("float32"))
+        ref = model(x).numpy()
+        packs = quantize_weights(model)
+        assert len(packs) == 2
+        out = model(x).numpy()
+        # int8 weight-only: output close but not identical
+        assert not np.array_equal(out, ref)
+        np.testing.assert_allclose(out, ref, rtol=0.2, atol=0.05)
+
+    def test_divide_no_offsupport_nans(self):
+        import paddle_tpu.sparse as sp
+
+        idx = np.array([[0, 0, 1, 2], [0, 2, 1, 0]])
+        vals = np.array([1.0, -2.0, 3.0, 0.5], "float32")
+        x = sp.sparse_coo_tensor(idx, vals, (3, 3))
+        out = sp.divide(x, x).to_dense().numpy()
+        want = np.zeros((3, 3), "float32")
+        want[idx[0], idx[1]] = 1.0
+        np.testing.assert_allclose(out, want)
+        assert np.isfinite(out).all()
+
+    def test_subtract_sparse_path(self):
+        import paddle_tpu.sparse as sp
+
+        idx = np.array([[0, 0, 1, 2], [0, 2, 1, 0]])
+        vals = np.array([1.0, -2.0, 3.0, 0.5], "float32")
+        x = sp.sparse_coo_tensor(idx, vals, (3, 3))
+        z = sp.subtract(x, x).to_dense().numpy()
+        np.testing.assert_allclose(z, np.zeros((3, 3)))
+
+    def test_softmax_3d_per_row(self):
+        import paddle_tpu.sparse as sp
+
+        # two batch slices, same row: normalization must be per [b, r]
+        idx = np.array([[0, 0, 1], [0, 0, 0], [0, 1, 0]])
+        vals = np.array([1.0, 2.0, 5.0], "float32")
+        x = sp.sparse_coo_tensor(idx, vals, (2, 1, 2))
+        out = sp.nn.Softmax()(x).to_dense().numpy()
+        e = np.exp([1.0 - 2.0, 0.0])
+        np.testing.assert_allclose(
+            out[0, 0], e / e.sum(), rtol=1e-5
+        )
+        np.testing.assert_allclose(out[1, 0, 0], 1.0, rtol=1e-6)
