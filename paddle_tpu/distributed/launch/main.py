@@ -61,7 +61,11 @@ def _parse(argv):
                         "(scale-down) and relaunch; requires --master")
     p.add_argument("--elastic_grace", type=float, default=5.0,
                    help="seconds the master waits for members to register "
-                        "before sealing a (possibly smaller) epoch")
+                        "before sealing a (possibly smaller) RE-rendezvous "
+                        "epoch")
+    p.add_argument("--elastic_join_timeout", type=float, default=300.0,
+                   help="seconds the master waits for the FULL node set "
+                        "at the initial (epoch 0) rendezvous")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -151,7 +155,12 @@ def _elastic_launch(args):
         )
         store.set(f"epoch/{epoch}/node/{args.rank}", "alive")
         if args.rank == 0:
-            deadline = time.time() + args.elastic_grace
+            # epoch 0 is the initial rendezvous: wait for the FULL node
+            # set (the reference's job-start join); re-rendezvous epochs
+            # use the short grace and seal with the survivors
+            wait = (args.elastic_join_timeout if epoch == 0
+                    else args.elastic_grace)
+            deadline = time.time() + wait
             while time.time() < deadline:
                 n = len(store.list_keys(f"epoch/{epoch}/node/"))
                 if n >= args.nnodes:

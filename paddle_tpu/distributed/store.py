@@ -138,8 +138,26 @@ class TCPStore:
 
     def _rpc(self, op, key="", value=None):
         with self._lock:
-            _send_frame(self._sock, {"op": op, "key": key, "value": value})
-            resp = _recv_frame(self._sock)
+            try:
+                _send_frame(
+                    self._sock, {"op": op, "key": key, "value": value}
+                )
+                resp = _recv_frame(self._sock)
+            except OSError:
+                resp = None
+            if resp is None:
+                # a long-lived connection can be dropped under load (the
+                # reference store client reconnects the same way); retry
+                # once on a fresh socket before giving up
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = self._connect()
+                _send_frame(
+                    self._sock, {"op": op, "key": key, "value": value}
+                )
+                resp = _recv_frame(self._sock)
         if resp is None:
             raise ConnectionError("TCPStore server closed the connection")
         return resp

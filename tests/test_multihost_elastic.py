@@ -174,18 +174,50 @@ start = 0
 if os.path.exists(ckpt):
     start = json.load(open(ckpt))["step"]
 print(f"worker rank={rank} world={world} resume_from={start}", flush=True)
-TOTAL = 12
+# rendezvous: a real job's first collective synchronizes the ranks; here
+# rank 0 must not finish training before rank 1 even starts (the crash
+# at step 3 has to land mid-train)
+if world == 2:
+    me = os.path.join(out, f"started.{rank}")
+    open(me, "w").write("x")
+    peer = os.path.join(out, f"started.{1 - rank}")
+    deadline = time.time() + 120
+    while not os.path.exists(peer):
+        if time.time() > deadline:
+            sys.exit(3)
+        time.sleep(0.05)
+TOTAL = 40
+hb = os.path.join(out, "hb.1")
 for step in range(start, TOTAL):
     time.sleep(0.15)
-    if rank == 1 and world == 2 and step == 3:
-        print("simulating node crash", flush=True)
-        sys.exit(1)
+    if rank == 1 and world == 2:
+        if step == 3:
+            # NODE loss, not worker loss: take the launcher down too
+            # (a surviving launcher would legitimately rejoin the next
+            # epoch and recover at full world — also correct, but not
+            # what this test pins)
+            print("simulating node crash", flush=True)
+            import signal
+
+            os.kill(os.getppid(), signal.SIGKILL)
+            sys.exit(1)
+        open(hb, "w").write(str(step))
+    if rank == 0 and world == 2 and step > 3:
+        # a real collective would time out when the peer dies; surface
+        # the failure so elasticity triggers from this side too
+        if not os.path.exists(hb) or time.time() - os.path.getmtime(hb) > 3:
+            print("peer heartbeat lost — aborting step", flush=True)
+            sys.exit(2)
     if rank == 0:
-        with open(ckpt, "w") as f:
+        tmp = ckpt + ".tmp"
+        with open(tmp, "w") as f:
             json.dump({"step": step + 1, "world": world}, f)
+        os.replace(tmp, ckpt)  # SIGTERM mid-write must not corrupt
 if rank == 0:
-    with open(ckpt, "w") as f:
+    tmp = ckpt + ".tmp"
+    with open(tmp, "w") as f:
         json.dump({"step": TOTAL, "world": world, "done": True}, f)
+    os.replace(tmp, ckpt)
 print(f"worker rank={rank} finished", flush=True)
 """
 
@@ -217,14 +249,14 @@ class TestElasticScaleDown:
 
         a = launch(0, 3)
         b = launch(1, 0)
-        code_b = b.wait(timeout=150)
-        code_a = a.wait(timeout=150)
+        code_b = b.wait(timeout=300)
+        code_a = a.wait(timeout=300)
         out_a = a.stdout.read().decode()
         assert code_a == 0, out_a
-        assert code_b != 0  # the lost node exits nonzero
+        assert code_b != 0  # the lost node dies (SIGKILLed launcher)
         state = json.load(open(out / "state.json"))
         assert state.get("done") is True
         assert state["world"] == 1  # finished at the scaled-down world
-        assert state["step"] == 12
+        assert state["step"] == 40
         # the survivor went through a second epoch with remapped ranks
         assert "epoch 1 sealed with nodes [0]" in out_a
