@@ -45,6 +45,7 @@ from .core.device import (
     is_compiled_with_tpu,
     set_device,
 )
+from .core import errors  # typed error registry (enforce.h analogue)
 from .core.dtype import (
     bfloat16,
     bool_,

@@ -1,0 +1,98 @@
+"""Typed error registry + enforce helpers.
+
+ref: paddle/common/enforce.h (PADDLE_ENFORCE_* macros) and
+paddle/common/errors.h (the error-category registry surfaced to Python
+as paddle.base.core.{EnforceNotMet, InvalidArgumentError, ...}). The
+reference attaches a category code to every runtime check so callers
+can catch classes of failure; the macros add the failing expression and
+location. Here: one exception per category (each also subclassing the
+closest builtin so existing `except ValueError` code keeps working) and
+`enforce()` / `enforce_eq()` helpers used at the framework's own check
+sites.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "ResourceExhaustedError",
+    "PreconditionNotMetError", "PermissionDeniedError",
+    "ExecutionTimeoutError", "UnimplementedError", "UnavailableError",
+    "FatalError", "ExternalError",
+    "enforce", "enforce_eq", "enforce_gt", "enforce_in",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base of every typed framework error (ref enforce.h:EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class FatalError(EnforceNotMet):
+    pass
+
+
+class ExternalError(EnforceNotMet):
+    pass
+
+
+def enforce(cond, msg, exc=InvalidArgumentError):
+    """PADDLE_ENFORCE analogue: raise the typed error when cond is
+    false. msg may be a callable (lazy formatting of expensive reprs)."""
+    if not cond:
+        raise exc(msg() if callable(msg) else msg)
+
+
+def enforce_eq(a, b, what="value", exc=InvalidArgumentError):
+    """PADDLE_ENFORCE_EQ: includes both sides in the message."""
+    if a != b:
+        raise exc(f"{what}: expected {b!r}, got {a!r}")
+
+
+def enforce_gt(a, b, what="value", exc=InvalidArgumentError):
+    if not a > b:
+        raise exc(f"{what}: expected > {b!r}, got {a!r}")
+
+
+def enforce_in(a, allowed, what="value", exc=InvalidArgumentError):
+    if a not in allowed:
+        raise exc(f"{what}: expected one of {sorted(allowed)!r}, "
+                  f"got {a!r}")

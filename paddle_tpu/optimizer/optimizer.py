@@ -217,8 +217,8 @@ class Optimizer:
         the per-param static attrs (AdamW overrides)."""
         return 0.0, 1.0
 
-    def _make_step_fn(self):
-        clip = self._grad_clip
+    def _make_step_fn(self, use_clip=True):
+        clip = self._grad_clip if use_clip else None
 
         def step_fn(attrs, out_shardings, lr, t, found_inf, params, grads,
                     states):
@@ -264,7 +264,17 @@ class Optimizer:
                 new_states.append(ns)
             return new_params, new_states
 
-        return jax.jit(step_fn, static_argnums=(0, 1))
+        # Donate params + optimizer state on the CPU backend: the update
+        # then runs in place (old buffers are rebound right after), which
+        # is what lets an 8B-state dryrun fit host RAM. NOT donated on
+        # TPU: the remote-AOT tunnel round-trips donated buffers for
+        # small models (see BASELINE.md r4 investigation); TrainStep owns
+        # donation on the real-chip path. Grads stay undonated so
+        # p.grad remains readable after step().
+        donate = (5, 7) if jax.default_backend() == "cpu" else ()
+        return jax.jit(
+            step_fn, static_argnums=(0, 1), donate_argnums=donate
+        )
 
     @staticmethod
     def _param_out_sharding(p_arr, state):
@@ -297,12 +307,48 @@ class Optimizer:
         param_target = sh if isinstance(sh, NamedSharding) else replicated
         return param_target, state_targets
 
+    # When set (int), step() updates parameters in groups of this many
+    # instead of one whole-tree program: transient memory per update
+    # call drops to O(group bytes) — the knob that lets an 8B-state
+    # virtual-mesh dryrun fit host RAM (one program per group shape is
+    # cached by jit as usual). None = single fused program (default,
+    # fastest on a real chip).
+    step_chunk: int | None = None
+
     @autograd.no_grad()
     def step(self):
         triples = self._collect()
         if not triples:
             self._global_step += 1
             return
+        if self.step_chunk:
+            k = int(self.step_chunk)
+            if k <= 0:
+                raise ValueError(
+                    f"step_chunk must be a positive int, got {k}"
+                )
+            if self._grad_clip is not None:
+                # global-norm clipping must see the WHOLE gradient tree;
+                # clip once up front, then update chunks with clipping
+                # disabled (per-chunk clipping would re-normalize by each
+                # chunk's own norm)
+                params = [p for p, _, _ in triples]
+                grads = [g for _, g, _ in triples]
+                clipped = self._grad_clip._clip_arrays(
+                    [p._data for p in params], grads,
+                    [a.need_clip for _, _, a in triples],
+                )
+                triples = [
+                    (p, g, a) for (p, _, a), g in zip(triples, clipped)
+                ]
+            for i in range(0, len(triples), k):
+                self._step_group(triples[i:i + k], use_clip=False)
+            self._global_step += 1
+            return
+        self._step_group(triples)
+        self._global_step += 1
+
+    def _step_group(self, triples, use_clip=True):
         params = [p for p, _, _ in triples]
         grads = [g for _, g, _ in triples]
         attrs = tuple(a for _, _, a in triples)
@@ -330,16 +376,37 @@ class Optimizer:
             self._param_out_sharding(p._data, st)
             for p, st in zip(params, states)
         )
-        if self._compiled_step is None:
-            self._compiled_step = self._make_step_fn()
-        new_params, new_states = self._compiled_step(
-            attrs, targets, lr, t, found_inf,
-            [p._data for p in params], grads, states,
-        )
+        if use_clip:
+            if self._compiled_step is None:
+                self._compiled_step = self._make_step_fn()
+            compiled = self._compiled_step
+        else:
+            if getattr(self, "_compiled_step_noclip", None) is None:
+                self._compiled_step_noclip = self._make_step_fn(
+                    use_clip=False
+                )
+            compiled = self._compiled_step_noclip
+        try:
+            new_params, new_states = compiled(
+                attrs, targets, lr, t, found_inf,
+                [p._data for p in params], grads, states,
+            )
+        except Exception as e:
+            import jax
+
+            if jax.default_backend() == "cpu":
+                # params/states were DONATED into the failed call and are
+                # gone; say so instead of letting later accesses die with
+                # an opaque "Array has been deleted"
+                raise RuntimeError(
+                    "optimizer update failed AFTER its parameter/state "
+                    "buffers were donated — training state is destroyed; "
+                    "restore from a checkpoint"
+                ) from e
+            raise
         for p, np_, ns in zip(params, new_params, new_states):
             p._rebind(np_)
             self._accumulators[id(p)] = ns
-        self._global_step += 1
 
     def _update(self, p, g, state, lr, t, attr):
         raise NotImplementedError

@@ -478,3 +478,93 @@ class TestReviewRegressions:
         for _ in range(3):
             s.step()
         assert abs(s() - 0.125) < 1e-12
+
+
+class TestChunkedStep:
+    def test_chunked_matches_fused(self):
+        """step_chunk=1 (per-leaf update programs) must produce exactly
+        the fused whole-tree update."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        def build():
+            paddle.seed(0)
+            m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+            o = paddle.optimizer.AdamW(
+                learning_rate=1e-2, parameters=m.parameters())
+            return m, o
+
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype("float32"))
+
+        def train(m, o, steps=3):
+            for _ in range(steps):
+                loss = (m(x) ** 2).mean()
+                loss.backward()
+                o.step()
+                o.clear_grad()
+            return [p.numpy() for p in m.parameters()]
+
+        m1, o1 = build()
+        ref = train(m1, o1)
+        m2, o2 = build()
+        o2.step_chunk = 1
+        got = train(m2, o2)
+        for a, b in zip(got, ref):
+            np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+    def test_chunked_with_global_clip_matches_fused(self):
+        """Global-norm clipping must see the whole gradient tree even
+        under chunked stepping (clip-once-then-chunk)."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        def build():
+            paddle.seed(1)
+            m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+            o = paddle.optimizer.AdamW(
+                learning_rate=1e-1, parameters=m.parameters(),
+                grad_clip=nn.ClipGradByGlobalNorm(0.01),
+            )
+            return m, o
+
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(4, 8).astype("float32") * 10)
+
+        def train(m, o):
+            for _ in range(2):
+                loss = (m(x) ** 2).mean()
+                loss.backward()
+                o.step()
+                o.clear_grad()
+            return [p.numpy() for p in m.parameters()]
+
+        m1, o1 = build()
+        ref = train(m1, o1)
+        m2, o2 = build()
+        o2.step_chunk = 1
+        got = train(m2, o2)
+        for a, b in zip(got, ref):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+    def test_bad_step_chunk_raises(self):
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import pytest
+
+        m = nn.Linear(4, 4)
+        o = paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=m.parameters())
+        o.step_chunk = -1
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        m(x).sum().backward()
+        with pytest.raises(ValueError, match="positive"):
+            o.step()

@@ -189,3 +189,41 @@ def test_nonzero_unique_host_fallback():
     np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
     u = paddle.unique(paddle.to_tensor([3, 1, 3, 2]))
     np.testing.assert_array_equal(np.sort(u.numpy()), [1, 2, 3])
+
+
+class TestTypedErrors:
+    """ref common/enforce.h / errors.h: typed categories, each also a
+    builtin subclass so generic handlers keep working."""
+
+    def test_categories_and_builtin_compat(self):
+        from paddle_tpu import errors
+
+        assert issubclass(errors.InvalidArgumentError, ValueError)
+        assert issubclass(errors.NotFoundError, KeyError)
+        assert issubclass(errors.OutOfRangeError, IndexError)
+        assert issubclass(errors.UnimplementedError, NotImplementedError)
+        assert issubclass(errors.ResourceExhaustedError, MemoryError)
+        for n in ("InvalidArgumentError", "NotFoundError",
+                  "PreconditionNotMetError", "UnavailableError"):
+            assert issubclass(getattr(errors, n), errors.EnforceNotMet)
+
+    def test_enforce_helpers(self):
+        import pytest
+
+        from paddle_tpu import errors
+
+        errors.enforce(True, "fine")
+        with pytest.raises(errors.InvalidArgumentError, match="bad"):
+            errors.enforce(False, "bad thing")
+        with pytest.raises(ValueError):  # builtin compat
+            errors.enforce(False, "bad thing")
+        with pytest.raises(errors.InvalidArgumentError,
+                           match="expected 4"):
+            errors.enforce_eq(3, 4, "heads")
+        with pytest.raises(errors.InvalidArgumentError,
+                           match="one of"):
+            errors.enforce_in("x", {"a", "b"}, "mode")
+        # lazy message only formats on failure
+        calls = []
+        errors.enforce(True, lambda: calls.append(1) or "msg")
+        assert not calls
