@@ -23,7 +23,9 @@ Config schema (all keys optional; degree 1 = axis absent):
       "dp_degree": int, "mp_degree": int, "pp_degree": int,
       "dp_config": {"sharding_level": 0|1|2|3},
       "mp_config": {"parallelize_plan": "auto" | {pattern: plan}},
-      "pp_config": {"schedule": "1f1b"|"gpipe", "micro_batches": int,
+      "pp_config": {"schedule": "1f1b"|"gpipe"|"vpp"|"zero_bubble",
+                    "micro_batches": int, "virtual_pp": int,
+                    "remat": bool (gpipe/vpp only),
                     "dtype": "bfloat16"|None},
     }
 """
@@ -264,6 +266,7 @@ def parallelize(model, optimizer=None, mesh=None, config=None):
             axis_name="pp",
             num_micro_batches=pp_cfg.get("micro_batches"),
             schedule=pp_cfg.get("schedule", "1f1b"),
+            remat=bool(pp_cfg.get("remat", False)),
             data_axis="dp" if dp > 1 else None,
             tp_axis="tp" if mp > 1 else None,
             dtype=pp_cfg.get("dtype"),

@@ -314,6 +314,11 @@ class Optimizer:
     # cached by jit as usual). None = single fused program (default,
     # fastest on a real chip).
     step_chunk: int | None = None
+    # With step_chunk: drop each group's p.grad right after its update,
+    # so gradient memory shrinks as the chunked sweep advances (for
+    # state sizes near host RAM). Off by default — p.grad stays
+    # readable after step() otherwise.
+    chunk_free_grads: bool = False
 
     @autograd.no_grad()
     def step(self):
@@ -342,7 +347,16 @@ class Optimizer:
                     (p, g, a) for (p, _, a), g in zip(triples, clipped)
                 ]
             for i in range(0, len(triples), k):
-                self._step_group(triples[i:i + k], use_clip=False)
+                group = triples[i:i + k]
+                self._step_group(group, use_clip=False)
+                if self.chunk_free_grads:
+                    for j in range(i, min(i + k, len(triples))):
+                        # release BOTH references to the grad array (the
+                        # triples list pins it too) so the buffer is
+                        # actually reclaimable mid-sweep
+                        p = triples[j][0]
+                        p.grad = None
+                        triples[j] = None
             self._global_step += 1
             return
         self._step_group(triples)
