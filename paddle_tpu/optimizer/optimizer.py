@@ -31,6 +31,18 @@ from .lr import LRScheduler
 __all__ = ["Optimizer"]
 
 
+def _malloc_trim():
+    """Hand freed glibc arena back to the OS (near-host-RAM chunked
+    sweeps: freed device buffers otherwise stay resident as arena and
+    the next group's temps OOM the box)."""
+    try:
+        import ctypes
+
+        ctypes.CDLL("libc.so.6").malloc_trim(0)
+    except Exception:
+        pass
+
+
 class _PAttr(NamedTuple):
     """Static (hashable) per-parameter attributes baked into the staged
     update: jit sees them as compile-time constants."""
@@ -357,6 +369,7 @@ class Optimizer:
                         p = triples[j][0]
                         p.grad = None
                         triples[j] = None
+                    _malloc_trim()
             self._global_step += 1
             return
         self._step_group(triples)
