@@ -22,7 +22,9 @@ if (
     _os.environ.get("PADDLE_MASTER")
     and int(_os.environ.get("PADDLE_TRAINERS_NUM", "1")) > 1
     and _os.environ.get("PADDLE_DISABLE_AUTO_DIST") != "1"
-    and not _os.environ.get("PADDLE_TPU_DIST_INITED")
+    # PID-stamped: a bare inherited "1" would make spawned workers skip
+    # their own jax.distributed.initialize
+    and _os.environ.get("PADDLE_TPU_DIST_INITED") != str(_os.getpid())
 ):
     import jax as _jax
 
@@ -31,7 +33,7 @@ if (
         num_processes=int(_os.environ["PADDLE_TRAINERS_NUM"]),
         process_id=int(_os.environ.get("PADDLE_TRAINER_ID", "0")),
     )
-    _os.environ["PADDLE_TPU_DIST_INITED"] = "1"
+    _os.environ["PADDLE_TPU_DIST_INITED"] = str(_os.getpid())
 
 from .core import autograd as _autograd_mod
 from .core import dtype as _dtype_mod

@@ -178,7 +178,13 @@ def _elastic_launch(args):
             store.set(f"epoch/{epoch}/plan", _json.dumps(plan))
             print(f"elastic: epoch {epoch} sealed with nodes {members}",
                   file=sys.stderr)
-        plan = _json.loads(store.get(f"epoch/{epoch}/plan"))
+        # the master seals epoch 0 only after --elastic_join_timeout, so
+        # non-master nodes must out-wait that window (store default is
+        # 120s; a straggler sealing late would otherwise kill the others)
+        plan = _json.loads(store.get(
+            f"epoch/{epoch}/plan",
+            timeout=args.elastic_join_timeout + 60.0,
+        ))
         my_rank = plan["ranks"].get(str(args.rank))
         if my_rank is None:
             print(f"elastic: node {args.rank} not in epoch {epoch}; "

@@ -66,7 +66,8 @@ def init_parallel_env():
         )
         world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
         if (coord and world > 1
-                and not os.environ.get("PADDLE_TPU_DIST_INITED")):
+                and os.environ.get("PADDLE_TPU_DIST_INITED")
+                    != str(os.getpid())):
             import jax
 
             jax.distributed.initialize(
@@ -74,7 +75,7 @@ def init_parallel_env():
                 num_processes=world,
                 process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")),
             )
-            os.environ["PADDLE_TPU_DIST_INITED"] = "1"
+            os.environ["PADDLE_TPU_DIST_INITED"] = str(os.getpid())
         _parallel_env = ParallelEnv()
     return _parallel_env
 

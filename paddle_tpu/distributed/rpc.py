@@ -15,9 +15,11 @@ from __future__ import annotations
 
 import concurrent.futures as _fut
 import pickle
+import secrets
 import socket
 import socketserver
 import threading
+from hmac import compare_digest as _compare_digest
 
 from .store import TCPStore
 
@@ -65,6 +67,13 @@ def _recv_msg(sock):
 
 class _RpcHandler(socketserver.BaseRequestHandler):
     def handle(self):
+        # authenticate before unpickling: the peer must present the
+        # cluster token rendezvoused through the TCPStore (RPC is code
+        # execution by design; the token keeps it to cluster peers)
+        tok = _recv_exact(self.request, 16)
+        if tok is None or not _compare_digest(tok, self.server.token):
+            self.request.close()
+            return
         buf = _recv_msg(self.request)
         if buf is None:
             return
@@ -95,13 +104,25 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         "PADDLE_MASTER", "127.0.0.1:29590")
     host, port = master_endpoint.rsplit(":", 1)
 
-    server = _RpcServer(("0.0.0.0", 0), _RpcHandler)
-    my_port = server.server_address[1]
-    threading.Thread(target=server.serve_forever, daemon=True).start()
-
     store = TCPStore(host, int(port) + 7, is_master=rank == 0, timeout=60)
     my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else (
         socket.gethostbyname(socket.gethostname()))
+    # bind the rendezvoused interface only (not 0.0.0.0) and gate every
+    # payload behind a shared 128-bit token published by rank 0 through
+    # the store — unauthenticated pickle off the wire is RCE
+    try:
+        server = _RpcServer((my_ip, 0), _RpcHandler)
+    except OSError:
+        # hostname resolves to a non-local address (NAT / stale hosts
+        # file): fall back to all interfaces — the token still gates
+        # every payload
+        server = _RpcServer(("0.0.0.0", 0), _RpcHandler)
+    my_port = server.server_address[1]
+    if rank == 0:
+        store.set("rpc/token", secrets.token_bytes(16).hex())
+    token = bytes.fromhex(store.get("rpc/token"))
+    server.token = token
+    threading.Thread(target=server.serve_forever, daemon=True).start()
     store.set(f"rpc/{rank}", f"{name},{my_ip},{my_port}")
     infos = {}
     for r in range(world_size):
@@ -109,7 +130,7 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         infos[nm] = WorkerInfo(nm, r, ip, int(p))
     _state.update(
         server=server, store=store, infos=infos, rank=rank, name=name,
-        pool=_fut.ThreadPoolExecutor(max_workers=8),
+        token=token, pool=_fut.ThreadPoolExecutor(max_workers=8),
     )
     # all workers up before anyone issues calls
     store.barrier("rpc_init", world_size)
@@ -132,7 +153,8 @@ def _call(to, fn, args, kwargs, timeout):
     payload = pickle.dumps((fn, args or (), kwargs or {}))
     with socket.create_connection((info.ip, info.port),
                                   timeout=timeout) as s:
-        s.sendall(len(payload).to_bytes(8, "big") + payload)
+        s.sendall(_state["token"]
+                  + len(payload).to_bytes(8, "big") + payload)
         buf = _recv_msg(s)
         if buf is None:
             raise ConnectionError("rpc peer closed the connection")

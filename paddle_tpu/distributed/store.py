@@ -175,9 +175,11 @@ class TCPStore:
         self._rpc("delete", stale)
         self._rpc("set", key_t, value)
 
-    def get(self, key: str, wait=True):
-        """Blocking get (the reference's wait-then-get contract)."""
-        deadline = time.time() + self.timeout
+    def get(self, key: str, wait=True, timeout=None):
+        """Blocking get (the reference's wait-then-get contract).
+        timeout overrides the store-wide default for this call (e.g.
+        the elastic launcher waits out the epoch-0 join window)."""
+        deadline = time.time() + (timeout or self.timeout)
         while True:
             for kt in ("s:" + key, "b:" + key):
                 resp = self._rpc("get", kt)

@@ -49,6 +49,7 @@ class CommWatchdog:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self.fired = None      # (tag, why) after a trip
+        self._seen_abort = None  # last ABORT_KEY value acted on
         if store is not None:
             try:  # a fresh watchdog must not trip on a PREVIOUS abort
                 store.delete_key(ABORT_KEY)
@@ -70,9 +71,12 @@ class CommWatchdog:
             return self
 
         def __exit__(self, *exc):
-            self._wd._clear(self._id)
-            if exc[0] is None and self._wd.fired is not None:
-                tag, why = self._wd.fired
+            # _clear returns the trip observed ATOMICALLY with the
+            # deregistration: another thread's _register may re-arm
+            # (fired=None) the instant our registration leaves _active
+            fired = self._wd._clear(self._id)
+            if exc[0] is None and fired is not None:
+                tag, why = fired
                 raise CommTimeoutError(
                     f"communication watchdog fired during {tag!r}: {why}"
                 )
@@ -83,6 +87,21 @@ class CommWatchdog:
 
     def _register(self, tag, timeout):
         with self._lock:
+            # a trip is one-shot for the scopes that observed it (they
+            # raise at exit); the FIRST scope opened after all of those
+            # drained re-arms the watchdog. The monitor thread exits
+            # after a trip, so always start a fresh one (the old one may
+            # still be finishing its stack dump — it returns on its own).
+            # The propagated ABORT_KEY is deliberately NOT deleted here:
+            # peers may not have polled it yet; _seen_abort makes this
+            # watchdog ignore aborts it already acted on. No store I/O
+            # under the lock.
+            if self.fired is not None and not self._active:
+                self.fired = None
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True
+                )
+                self._thread.start()
             wid = self._next
             self._next += 1
             self._active[wid] = (tag, time.time() + timeout)
@@ -91,6 +110,7 @@ class CommWatchdog:
     def _clear(self, wid):
         with self._lock:
             self._active.pop(wid, None)
+            return self.fired
 
     # -- the background loop ----------------------------------------------
     def _loop(self):
@@ -107,7 +127,8 @@ class CommWatchdog:
                     aborted = self.store.get(ABORT_KEY, wait=False)
                 except Exception:
                     aborted = None
-                if aborted:
+                if aborted and aborted != self._seen_abort:
+                    self._seen_abort = aborted
                     expired = (
                         "peer", f"abort propagated by {aborted}"
                     )
@@ -126,7 +147,11 @@ class CommWatchdog:
             sys.stderr.write("".join(traceback.format_stack(frame)))
         if self.store is not None and why == "local timeout":
             try:  # propagate so peers abort instead of waiting
-                self.store.set(ABORT_KEY, f"rank{self.rank}:{tag}")
+                # timestamp nonce: a repeat abort of the same tag must
+                # still read as NEW to re-armed peers
+                val = f"rank{self.rank}:{tag}@{time.time():.3f}"
+                self._seen_abort = val  # don't re-trip on our own abort
+                self.store.set(ABORT_KEY, val)
             except Exception:
                 pass
         if self._on_timeout is not None:

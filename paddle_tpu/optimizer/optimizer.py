@@ -276,14 +276,15 @@ class Optimizer:
                 new_states.append(ns)
             return new_params, new_states
 
-        # Donate params + optimizer state on the CPU backend: the update
-        # then runs in place (old buffers are rebound right after), which
-        # is what lets an 8B-state dryrun fit host RAM. NOT donated on
-        # TPU: the remote-AOT tunnel round-trips donated buffers for
-        # small models (see BASELINE.md r4 investigation); TrainStep owns
-        # donation on the real-chip path. Grads stay undonated so
+        # Donating params + optimizer state runs the update in place
+        # (old buffers are rebound right after) — the knob that lets an
+        # 8B-state dryrun fit host RAM. OPT-IN via donate_state: a donated
+        # update invalidates any user-held alias of a parameter buffer
+        # ('Array has been deleted'), and on TPU the remote-AOT tunnel
+        # round-trips donated buffers anyway (BASELINE.md r4); TrainStep
+        # owns donation on the real-chip path. Grads stay undonated so
         # p.grad remains readable after step().
-        donate = (5, 7) if jax.default_backend() == "cpu" else ()
+        donate = (5, 7) if self.donate_state else ()
         return jax.jit(
             step_fn, static_argnums=(0, 1), donate_argnums=donate
         )
@@ -326,6 +327,11 @@ class Optimizer:
     # cached by jit as usual). None = single fused program (default,
     # fastest on a real chip).
     step_chunk: int | None = None
+    # Donate param/state buffers into the update program (in-place
+    # semantics; see _build_step). Off by default — user-held aliases of
+    # parameter buffers stay valid. The virtual-mesh 8B dryrun turns it
+    # on to fit host RAM.
+    donate_state: bool = False
     # With step_chunk: drop each group's p.grad right after its update,
     # so gradient memory shrinks as the chunked sweep advances (for
     # state sizes near host RAM). Off by default — p.grad stays
@@ -403,6 +409,11 @@ class Optimizer:
             self._param_out_sharding(p._data, st)
             for p, st in zip(params, states)
         )
+        if getattr(self, "_compiled_donate", None) != self.donate_state:
+            # donate_state toggled after a build: drop stale programs
+            self._compiled_step = None
+            self._compiled_step_noclip = None
+            self._compiled_donate = self.donate_state
         if use_clip:
             if self._compiled_step is None:
                 self._compiled_step = self._make_step_fn()
@@ -419,9 +430,7 @@ class Optimizer:
                 [p._data for p in params], grads, states,
             )
         except Exception as e:
-            import jax
-
-            if jax.default_backend() == "cpu":
+            if self.donate_state:
                 # params/states were DONATED into the failed call and are
                 # gone; say so instead of letting later accesses die with
                 # an opaque "Array has been deleted"
