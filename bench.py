@@ -34,9 +34,18 @@ PEAK_BF16_FLOPS = {
 }
 
 
-def _timed_steps(fn, steps, sync):
-    t0 = time.perf_counter()
+def _timed_steps(fn, steps, sync, warmup=10):
+    """Steady-state step time. The first ~5-7 executions after compile
+    run up to ~50x slower through the remote-AOT tunnel (donated-buffer
+    steady state / HBM layout settling; measured r5: MoE level-1 steps
+    1-5 at 8.4 s, steps 8+ at 143 ms) — r4's "regressions" were timing
+    windows that landed in the settle phase. Warm up past it, then
+    time."""
     out = None
+    for _ in range(warmup):
+        out = fn()
+    sync(out)
+    t0 = time.perf_counter()
     for _ in range(steps):
         out = fn()
     sync(out)
@@ -220,12 +229,12 @@ def bench_moe(paddle, on_tpu, peak):
         _, loss = m(ids, labels=ids)
         return loss
 
-    # donate=False: buffer donation for the expert-stacked params is
-    # rejected/round-tripped by the remote-AOT tunnel and costs ~19s/step
-    # (measured: donate=True 19.1s vs donate=False 0.16s on the 2-layer
-    # probe); without donation the old+new state transiently coexists
-    # (~2x state bytes), which the shrink ladder accounts for
-    step = paddle.jit.TrainStep(model, loss_fn, opt, donate=False)
+    # donate=True: r4 measured a 19s/step donation pathology here and
+    # pinned the row to donate=False — r5 re-measured 98ms/step WITH
+    # donation on an uncontended host (the r4 number was tunnel/host
+    # contention, BASELINE r5 note). Donation halves the transient
+    # optimizer-state footprint, which is what lets level 0 fit.
+    step = paddle.jit.TrainStep(model, loss_fn, opt, donate=True)
     batch, seq = (batch_l, 1024) if on_tpu else (2, 32)
     ids = paddle.to_tensor(
         np.random.RandomState(0).randint(
@@ -339,8 +348,58 @@ ROWS = {
 }
 
 
+def _chip_canary(name, tries=4):
+    """Detect a busy/shared chip grant before timing anything.
+
+    Each python process claims a chip from the axon pool under a fresh
+    session id (sitecustomize.py register()); grants land on tiles with
+    wildly different residual load. r5 measured the IDENTICAL L=6 MoE
+    step at 133 ms and 12 s minutes apart — the difference was the
+    grant, not the code (r4's "superlinear MoE" / ResNet / DiT
+    regressions were the same lottery). A jitted 1024^2 bf16 matmul
+    chain takes ~1-3 ms/iter through the tunnel on a quiet chip; when
+    it measures 10x that, wait and re-check so the timed rows don't
+    record another tenant's workload."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() == "cpu":
+        return 0.0
+    x = jnp.zeros((1024, 1024), jnp.bfloat16)
+    f = jax.jit(lambda a: a @ a)
+    dt = 0.0
+    for attempt in range(tries):
+        jax.block_until_ready(f(x))
+        t0 = time.perf_counter()
+        o = x
+        for _ in range(10):
+            o = f(o)
+        jax.block_until_ready(o)
+        dt = (time.perf_counter() - t0) / 10
+        if dt < 10e-3:
+            log(f"[{name}] canary {dt*1e3:.1f}ms/matmul (chip quiet)")
+            return dt
+        log(f"[{name}] WARNING: canary {dt*1e3:.1f}ms/matmul — chip "
+            "grant is busy (shared pool); waiting 30s")
+        time.sleep(30)
+    log(f"[{name}] WARNING: proceeding on a busy chip "
+        f"({dt*1e3:.1f}ms/matmul) — timings are lower bounds")
+    return dt
+
+
 def _run_row(name):
     import paddle_tpu as paddle
+
+    # The tunnel client also needs the (single) host core to feed the
+    # chip: concurrent host load starves it and corrupts timings.
+    try:
+        load1 = os.getloadavg()[0]
+        if load1 > 1.5:
+            log(f"[{name}] WARNING: host load {load1:.1f} — timings "
+                "will be inflated (tunnel client starves); rerun idle")
+    except OSError:
+        pass
+    _chip_canary(name)
 
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     peak = PEAK_BF16_FLOPS.get(gen, 197e12)

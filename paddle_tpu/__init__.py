@@ -67,6 +67,14 @@ from .core.dtype import (
 )
 from .core.flags import get_flags, set_flags
 from .core.random import get_rng_state, seed, set_rng_state
+from .core.aux_tensors import (
+    StringTensor,
+    TensorArray,
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+)
 from .core.tensor import Tensor, to_tensor
 from .ops import *  # noqa: F401,F403
 from .ops import api as _ops_api

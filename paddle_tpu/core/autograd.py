@@ -100,6 +100,7 @@ class GradNode:
         "_pending",
         "post_hooks",
         "output_hooks",
+        "_cached_vjp",
     )
 
     def __init__(self, name, vjp_fn, inputs, n_outputs, out_treedef):
@@ -115,6 +116,7 @@ class GradNode:
         self.out_avals = []
         self._out_cotangents = None
         self._pending = 0
+        self._cached_vjp = False
         self.post_hooks = []
         # (out_index, hook) from register_hook on non-leaf outputs; fired
         # on the fully-accumulated output cotangent before the vjp runs

@@ -1,0 +1,169 @@
+"""TensorArray + StringTensor auxiliary tensor types.
+
+ref: paddle/phi/core/tensor_array.h (TensorArray — a dynamic-length
+array of DenseTensors used by array_write/array_read and control-flow
+ops) and paddle/phi/core/string_tensor.h (StringTensor — pstring
+payloads for the tokenizer op family; CPU-resident by design).
+
+TPU-native form: a TensorArray is a host-side ordered container of
+device Tensors — dynamic length is a HOST concept (XLA programs need
+static shapes), so writes/reads happen eagerly and ``stack``/``concat``
+produce ordinary device tensors that staged code consumes. Inside
+``to_static(full_graph=False)`` bodies the per-element ops still stage
+through the lazy-segment engine. StringTensor mirrors the reference:
+a numpy bytes/object array on host (strings never live in HBM — the
+reference's string kernels are likewise CPU-only).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .tensor import Tensor
+
+__all__ = [
+    "TensorArray", "create_array", "array_write", "array_read",
+    "array_length", "StringTensor",
+]
+
+
+class TensorArray(list):
+    """Dynamic-length array of Tensors (ref tensor_array.h). Inherits
+    list so the reference's dygraph contract — "TensorArray is a list in
+    dygraph mode" (python/paddle/tensor/array.py:71) — holds literally.
+    """
+
+    def __init__(self, dtype="float32", iterable=()):
+        super().__init__(iterable)
+        self.dtype = dtype
+
+    def write(self, i, value):
+        i = int(i)
+        if i < len(self):
+            self[i] = value
+        else:
+            while len(self) < i:
+                self.append(None)
+            self.append(value)
+        return self
+
+    def read(self, i):
+        return self[int(i)]
+
+    def length(self):
+        return len(self)
+
+    def stack(self, axis=0):
+        from .. import ops as F
+
+        return F.stack(list(self), axis=axis)
+
+    def concat(self, axis=0):
+        from .. import ops as F
+
+        return F.concat(list(self), axis=axis)
+
+
+def create_array(dtype="float32", initialized_list=None):
+    """ref python/paddle/tensor/array.py create_array."""
+    arr = TensorArray(dtype=dtype)
+    if initialized_list:
+        for v in initialized_list:
+            arr.append(v)
+    return arr
+
+
+def array_write(x, i, array=None):
+    """ref array.py array_write — returns the array (created on None)."""
+    if array is None:
+        array = TensorArray()
+    if not isinstance(array, list):
+        raise TypeError(
+            "The 'array' in array_write must be a TensorArray/list"
+        )
+    if isinstance(array, TensorArray):
+        array.write(i, x)
+    else:
+        idx = int(i)
+        if idx < len(array):
+            array[idx] = x
+        else:
+            array.append(x)
+    return array
+
+
+def array_read(array, i):
+    """ref array.py array_read."""
+    return array[int(i)]
+
+
+def array_length(array):
+    """ref array.py array_length."""
+    return len(array)
+
+
+class StringTensor:
+    """Host-resident tensor of strings (ref string_tensor.h pstring
+    payloads). Backed by a numpy array of python str; shape/numel/
+    reshape follow the dense-tensor surface, plus vectorized encode/
+    lower helpers the reference's tokenizer ops build on."""
+
+    def __init__(self, data, name=None):
+        if isinstance(data, StringTensor):
+            data = data._data
+        arr = np.asarray(data, dtype=object)
+        self._data = arr
+        self.name = name
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def numel(self):
+        return int(self._data.size)
+
+    def numpy(self):
+        return self._data
+
+    def reshape(self, shape):
+        return StringTensor(self._data.reshape(shape), name=self.name)
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, np.ndarray):
+            return StringTensor(out)
+        return out
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        other = other._data if isinstance(other, StringTensor) else other
+        return Tensor(np.asarray(self._data == other))
+
+    def lower(self):
+        return StringTensor(
+            np.vectorize(lambda s: s.lower(), otypes=[object])(self._data)
+        )
+
+    def upper(self):
+        return StringTensor(
+            np.vectorize(lambda s: s.upper(), otypes=[object])(self._data)
+        )
+
+    def encode(self, encoding="utf-8"):
+        """Bytes lengths + flat byte buffer as device tensors — the
+        boundary crossing the reference's faster_tokenizer kernels do
+        internally."""
+        blobs = [s.encode(encoding) for s in self._data.reshape(-1)]
+        lens = Tensor(np.array([len(b) for b in blobs], np.int32))
+        flat = Tensor(
+            np.frombuffer(b"".join(blobs), np.uint8).copy()
+        )
+        return lens, flat
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"

@@ -59,6 +59,52 @@ def _tree_flatten_tensors(args):
     )
 
 
+# --- eager per-op program cache ------------------------------------------
+# The reference makes eager dispatch cheap with ~72k LoC of generated C++
+# (eager_gen.py ad_func prologues + cached phi kernels; SURVEY §3.1).
+# The TPU-native analogue: cache ONE jitted (out, vjp) program per
+# (op, impl, input signature, static attrs) so repeated eager ops skip
+# re-tracing jax.vjp — jit's C++ fast path replaces the trace. Entries
+# are skipped for tracer inputs (staging must inline, not nest jit) and
+# blacklisted for ops that cannot trace (dynamic output shapes).
+from collections import OrderedDict as _OrderedDict
+
+ENABLE_OP_CACHE = True  # kill switch (perf A/B, debugging)
+_sig_cache: "_OrderedDict[tuple, Any]" = _OrderedDict()
+_SIG_CACHE_MAX = 1024
+_sig_blacklist: set = set()
+# jitted backward applier: the VJP closure is a pytree, so its residual
+# arrays are traced args and the transposed program compiles once per
+# residual/cotangent signature
+_bwd_apply = None
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return ("\x00seq",) + tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return ("\x00map",) + tuple(
+            sorted((k, _hashable(x)) for k, x in v.items())
+        )
+    if isinstance(v, (bool, int, float, complex)):
+        # 1 == 1.0 == True hash identically but can change op semantics
+        return (type(v).__name__, v)
+    hash(v)  # TypeError for unhashables -> caller skips the cache
+    return v
+
+
+def _sig_cache_put(key, entry):
+    _sig_cache[key] = entry
+    if len(_sig_cache) > _SIG_CACHE_MAX:
+        _sig_cache.popitem(last=False)
+
+
+def clear_op_cache():
+    """Drop cached per-op programs (tests / flag toggles)."""
+    _sig_cache.clear()
+    _sig_blacklist.clear()
+
+
 def _nan_inf_report(bad, name, level):
     """Host-side reaction to a detected NaN/Inf (shared by the eager and
     staged paths)."""
@@ -136,12 +182,47 @@ def call(op_name: str, impl: Callable, args: tuple, attrs: dict[str, Any]):
         (not t.stop_gradient) for t in in_tensors
     )
 
+    # template with tensor slots blanked: the op closure must NOT hold
+    # this call's input Tensors (cached programs would pin their buffers)
+    tset = set(tensor_idx)
+    template = tuple(
+        None if i in tset else x for i, x in enumerate(flat)
+    )
+
     def fn(*arrays):
-        rebuilt = list(flat)
+        rebuilt = list(template)
         for i, a in zip(tensor_idx, arrays):
             rebuilt[i] = a
         rebuilt_args = jax.tree_util.tree_unflatten(treedef, rebuilt)
         return impl(*rebuilt_args, **attrs)
+
+    # cached-program fast path: concrete inputs only (tracers must inline
+    # into the enclosing trace — nesting jit would block fusion there)
+    # and stable module-level impls only (per-call closures like
+    # jit_program / recompute / grad_op would retrace every call)
+    cache_key = None
+    if (
+        ENABLE_OP_CACHE
+        and getattr(impl, "__closure__", True) is None
+        and getattr(impl, "__module__", "").startswith("paddle_tpu.ops")
+        and not any(isinstance(a, jax.core.Tracer) for a in primals)
+    ):
+        try:
+            cache_key = (
+                op_name, impl, treedef, requires_grad,
+                tuple(tensor_idx),
+                tuple(
+                    (a.shape, str(a.dtype),
+                     bool(getattr(a, "weak_type", False)))
+                    for a in primals
+                ),
+                _hashable(tuple(x for x in template if x is not None)),
+                _hashable(attrs),
+            )
+        except TypeError:
+            cache_key = None
+        if cache_key is not None and cache_key in _sig_blacklist:
+            cache_key = None
 
     timer = _prof_timer  # capture: stop() on another thread may clear it
     t_prof = None
@@ -149,11 +230,40 @@ def call(op_name: str, impl: Callable, args: tuple, attrs: dict[str, Any]):
         import time as _time
 
         t_prof = _time.perf_counter()
-    if requires_grad:
-        out, vjp_fn = jax.vjp(fn, *primals)
-    else:
-        out = fn(*primals)
-        vjp_fn = None
+    cached_prog = False
+    if cache_key is not None:
+        entry = _sig_cache.get(cache_key)
+        if entry is None:
+            try:
+                if requires_grad:
+                    entry = jax.jit(lambda *p: jax.vjp(fn, *p))
+                else:
+                    entry = jax.jit(fn)
+                # compile probe BEFORE caching: unjittable ops
+                # (dynamic output shapes etc.) fall back for good
+                result0 = entry(*primals)
+                _sig_cache_put(cache_key, entry)
+            except Exception:
+                _sig_blacklist.add(cache_key)
+                cache_key = None
+        else:
+            # proven entry: a runtime failure here (OOM, bad values) is
+            # a REAL error — surface it; blacklisting would silently
+            # drop the op to the slow path for the process lifetime
+            _sig_cache.move_to_end(cache_key)
+            result0 = entry(*primals)
+        if cache_key is not None:
+            if requires_grad:
+                out, vjp_fn = result0
+            else:
+                out, vjp_fn = result0, None
+            cached_prog = True
+    if cache_key is None:
+        if requires_grad:
+            out, vjp_fn = jax.vjp(fn, *primals)
+        else:
+            out = fn(*primals)
+            vjp_fn = None
     if t_prof is not None:
         try:
             jax.block_until_ready(out)
@@ -191,6 +301,7 @@ def call(op_name: str, impl: Callable, args: tuple, attrs: dict[str, Any]):
             out_treedef,
         )
         node.fwd_fn = fn
+        node._cached_vjp = cached_prog
         node.out_avals = [
             (a.shape, a.dtype) if a is not None else ((), jnp.float32)
             for a in out_flat
@@ -294,5 +405,22 @@ def call_vjp(node, cotangents, create_graph=False):
         _, vjp_fn = jax.vjp(node.fwd_fn, *(t._data for t in node.inputs))
     else:
         vjp_fn = node.vjp_fn
-    in_cots = vjp_fn(cot_tree)
+    # compiled backward for cache-path nodes: the VJP closure is a
+    # pytree, so its residuals become traced args and the transposed
+    # program compiles once per signature (float0 cots and tracers take
+    # the direct interpreted path)
+    if getattr(node, "_cached_vjp", False) and not any(
+        isinstance(a, jax.core.Tracer)
+        or (isinstance(a, np.ndarray) and a.dtype == jax.dtypes.float0)
+        for a in cot_arrays
+    ):
+        global _bwd_apply
+        if _bwd_apply is None:
+            _bwd_apply = jax.jit(lambda v, ct: v(ct))
+        try:
+            in_cots = _bwd_apply(vjp_fn, cot_tree)
+        except Exception:
+            in_cots = vjp_fn(cot_tree)
+    else:
+        in_cots = vjp_fn(cot_tree)
     return _wrap_in_cots(node, in_cots)

@@ -4,6 +4,7 @@ Wires the DistTensor dispatch hook into core.dispatch at import time (the
 analogue of the generated dist branch in every ad_func).
 """
 from ..core import dispatch as _dispatch
+from .. import passes  # noqa: F401  (paddle.distributed.passes parity)
 from . import checkpoint  # noqa: F401
 from .communication import (
     Group,
@@ -21,6 +22,8 @@ from .communication import (
     scatter,
 )
 from .dispatch_hook import dist_dispatch as _dist_dispatch
+from .dist_model import DistModel, Strategy, to_static
+from .shard_loader import ShardDataloader, shard_dataloader
 from .dist_tensor import (
     DistMeta,
     dtensor_from_local,
@@ -93,5 +96,7 @@ __all__ = [
     "ShardingStage1", "ShardingStage2", "ShardingStage3",
     "group_sharded_parallel",
     "checkpoint", "TCPStore", "spawn", "rpc",
+    "ShardDataloader", "shard_dataloader",
+    "DistModel", "Strategy", "to_static", "passes",
     "enable_comm_watchdog", "disable_comm_watchdog", "get_comm_watchdog",
 ]

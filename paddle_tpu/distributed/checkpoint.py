@@ -24,7 +24,7 @@ from ..core.tensor import Tensor
 from .dist_tensor import shard_tensor, to_global_array
 from .placement import Partial, Replicate, Shard
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_async_save"]
 
 _META_FILE = "metadata.json"
 
@@ -45,10 +45,31 @@ def _placement_from_json(d):
     return Replicate()
 
 
+# in-flight async writers (ref save_state_dict.py:46 — async_save copies
+# device tensors out synchronously, then a worker thread does the IO;
+# wait_async_save() is the flush barrier)
+_async_writers: list = []
+
+
+def wait_async_save():
+    """Block until every pending async checkpoint write has finished,
+    re-raising the first writer failure."""
+    import threading  # noqa: F401  (documents the contract)
+
+    while _async_writers:
+        t, err = _async_writers.pop(0)
+        t.join()
+        if err:
+            raise err[0]
+
+
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, async_save=False):
     """Write each tensor once (global value) + dist metadata
-    (ref save_state_dict.py:145)."""
+    (ref save_state_dict.py:145). With async_save=True the device->host
+    snapshot happens NOW (so training may donate/overwrite buffers
+    immediately) and the file IO runs on a background thread; call
+    wait_async_save() as the flush barrier before relying on the files."""
     os.makedirs(path, exist_ok=True)
     meta = {"tensors": {}}
     arrays = {}
@@ -86,11 +107,6 @@ def save_state_dict(state_dict, path, process_group=None,
             meta["tensors"][key] = {"python": True}
             arrays[key] = value
 
-    np.savez(
-        os.path.join(path, "data.npz"),
-        **{k: v for k, v in arrays.items()
-           if isinstance(v, np.ndarray)},
-    )
     pyvals = {
         k: v for k, v in arrays.items() if not isinstance(v, np.ndarray)
     }
@@ -107,11 +123,35 @@ def save_state_dict(state_dict, path, process_group=None,
             "python value"
         )
 
-    with open(os.path.join(path, _META_FILE), "w") as f:
-        json.dump(
-            {"meta": meta, "python_values": pyvals}, f,
-            default=_json_default,
+    def _write():
+        np.savez(
+            os.path.join(path, "data.npz"),
+            **{k: v for k, v in arrays.items()
+               if isinstance(v, np.ndarray)},
         )
+        with open(os.path.join(path, _META_FILE), "w") as f:
+            json.dump(
+                {"meta": meta, "python_values": pyvals}, f,
+                default=_json_default,
+            )
+
+    if not async_save:
+        _write()
+        return
+
+    import threading
+
+    err: list = []
+
+    def _guarded():
+        try:
+            _write()
+        except Exception as e:  # surfaced at wait_async_save()
+            err.append(e)
+
+    t = threading.Thread(target=_guarded, daemon=False)
+    t.start()
+    _async_writers.append((t, err))
 
 
 def load_state_dict(state_dict, path, process_group=None,

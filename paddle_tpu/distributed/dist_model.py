@@ -1,0 +1,177 @@
+"""dist.to_static / DistModel / Strategy.
+
+ref: python/paddle/distributed/auto_parallel/api.py:1886 (Strategy),
+:2167 (DistModel — mode-switched static train/eval/predict callables),
+:2776 (to_static).
+
+TPU-native collapse: the reference lowers the dygraph layer + loss +
+optimizer into partitioned static Programs per mode; here each mode is
+one staged XLA program — jit.TrainStep for "train" (fwd+bwd+update,
+gradient accumulation via Strategy), StaticFunction-style staged
+callables for "eval"/"predict". GSPMD handles the partitioning the
+reference's planner/completer does by hand.
+"""
+from __future__ import annotations
+
+from ..core import autograd
+from ..core.tensor import Tensor
+
+__all__ = ["Strategy", "DistModel", "to_static"]
+
+
+class _Bag(dict):
+    """Attribute-style config bag (the reference's BaseConfig leaves)."""
+
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError:
+            raise AttributeError(k) from None
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class Strategy(_Bag):
+    """ref api.py:1886 — config groups: sharding, fused_passes,
+    gradient_merge, pipeline, amp. Only the knobs with a TPU-native
+    effect do anything; the rest are accepted for API parity."""
+
+    _DEFAULTS = {
+        "sharding": dict(enable=False, degree=8, stage=1),
+        "gradient_merge": dict(enable=False, k_steps=1, avg=True),
+        "pipeline": dict(enable=False, schedule_mode="1F1B",
+                         accumulate_steps=1),
+        "amp": dict(enable=False, dtype="float16", level="O1"),
+        "fused_passes": dict(enable=False, fused_passes_list=[]),
+    }
+
+    def __init__(self, config=None):
+        super().__init__()
+        cfg = dict(config or {})
+        for group, defaults in self._DEFAULTS.items():
+            self[group] = _Bag({**defaults, **cfg.get(group, {})})
+
+
+class DistModel:
+    """Mode-switched staged model (ref api.py:2167).
+
+        dist_model = dist.to_static(layer, loader, loss_fn, opt)
+        dist_model.train()
+        loss = dist_model(x, y)       # one staged train step
+        dist_model.eval()
+        loss = dist_model(x, y)       # staged eval loss
+        dist_model.predict()
+        outs = dist_model(x)          # staged forward
+    """
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, input_spec=None):
+        self.network = layer
+        self._loss = loss
+        self._opt = optimizer
+        self._strategy = strategy or Strategy()
+        self._train_step = None
+        self._eval_fn = None
+        self._predict_fn = None
+        if loss is not None and optimizer is not None:
+            self._mode = "train"
+        elif loss is not None:
+            self._mode = "eval"
+        else:
+            self._mode = "predict"
+
+    # -- mode switches (ref DistModel.train/eval/predict) ------------------
+    def train(self):
+        if self._loss is None or self._opt is None:
+            raise RuntimeError(
+                "train mode needs both a loss and an optimizer passed to "
+                "to_static"
+            )
+        self._mode = "train"
+        self.network.train()
+        return self
+
+    def eval(self):
+        if self._loss is None:
+            raise RuntimeError("eval mode needs a loss passed to to_static")
+        self._mode = "eval"
+        self.network.eval()
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+        return self
+
+    @property
+    def mode(self):
+        return self._mode
+
+    def _loss_fn(self, model, *args):
+        *inputs, label = args
+        out = model(*inputs)
+        loss = self._loss(out, label)
+        return loss.mean() if loss.ndim > 0 else loss
+
+    def __call__(self, *args):
+        args = tuple(
+            a if isinstance(a, Tensor) else Tensor(a) for a in args
+        )
+        if self._mode == "train":
+            if self._train_step is None:
+                from ..jit.api import TrainStep
+
+                gm = self._strategy.gradient_merge
+                accum = int(gm.k_steps) if gm.enable else None
+                self._train_step = TrainStep(
+                    self.network, self._loss_fn, self._opt,
+                    donate=False, accum_steps=accum,
+                )
+            return self._train_step(*args)
+        if self._mode == "eval":
+            if self._eval_fn is None:
+                from ..jit.api import StaticFunction
+
+                self._eval_fn = StaticFunction(
+                    lambda *a: self._loss_fn(self.network, *a)
+                )
+            with autograd.no_grad():
+                return self._eval_fn(*args)
+        if self._predict_fn is None:
+            from ..jit.api import StaticFunction
+
+            self._predict_fn = StaticFunction(
+                self.network.forward, layer=self.network
+            )
+        with autograd.no_grad():
+            return self._predict_fn(*args)
+
+    # -- state passthrough (ref DistModel state_dict) ----------------------
+    def state_dict(self, mode="all"):
+        sd = dict(self.network.state_dict())
+        if mode in ("all", "opt") and self._opt is not None:
+            for k, v in self._opt.state_dict().items():
+                sd[f"opt.{k}"] = v
+        if mode == "opt":
+            sd = {k: v for k, v in sd.items() if k.startswith("opt.")}
+        return sd
+
+    def set_state_dict(self, state_dict):
+        net_sd = {k: v for k, v in state_dict.items()
+                  if not k.startswith("opt.")}
+        self.network.set_state_dict(net_sd)
+        opt_sd = {k[4:]: v for k, v in state_dict.items()
+                  if k.startswith("opt.")}
+        if opt_sd and self._opt is not None:
+            self._opt.set_state_dict(opt_sd)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None,
+              strategy=None, input_spec=None):
+    """ref api.py:2776 — returns a DistModel; the loader argument is
+    accepted for parity (shapes come from the first call; jax.jit caches
+    per signature, so no ahead-of-time spec inference is needed)."""
+    return DistModel(layer, loader=loader, loss=loss,
+                     optimizer=optimizer, strategy=strategy,
+                     input_spec=input_spec)

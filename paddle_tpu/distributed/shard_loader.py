@@ -1,0 +1,111 @@
+"""shard_dataloader: pre-sharded batches on a mesh axis.
+
+ref: python/paddle/distributed/auto_parallel/api.py:3301
+(shard_dataloader / ShardDataloader — split the loader along a mesh dim
+for data parallelism and emit DistTensors placed on the mesh).
+
+TPU-native form: batches stay GLOBAL arrays; each yielded tensor is
+placed with dist.shard_tensor([Shard(0) on the named axis]) so GSPMD
+sees the dp split — under multi-controller each host only materializes
+its addressable shard. ``shard_dims=None`` keeps batches replicated
+(mp-style inputs), matching the reference default.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from .dist_tensor import shard_tensor
+from .placement import Replicate, Shard
+from .process_mesh import ProcessMesh
+
+__all__ = ["ShardDataloader", "shard_dataloader"]
+
+
+def _axis_index(mesh: ProcessMesh, dim):
+    if dim is None:
+        return None
+    if isinstance(dim, str):
+        if dim not in mesh.dim_names:
+            raise ValueError(
+                f"shard_dim {dim!r} not in mesh axes {mesh.dim_names}"
+            )
+        return mesh.dim_names.index(dim)
+    return int(dim)
+
+
+class ShardDataloader:
+    """Iterates the wrapped loader, placing every yielded Tensor on its
+    mesh: batch axis 0 sharded over the chosen mesh dim (dp), remaining
+    axes replicated. len() follows the inner loader."""
+
+    def __init__(self, dataloader, meshes, input_keys=None,
+                 shard_dims=None, is_dataset_splitted=False):
+        self._loader = dataloader
+        self._meshes = (
+            list(meshes) if isinstance(meshes, (list, tuple)) else [meshes]
+        )
+        self._input_keys = list(input_keys) if input_keys else None
+        if isinstance(shard_dims, (list, tuple)):
+            dims = list(shard_dims)
+        else:
+            dims = [shard_dims] * len(self._meshes)
+        if len(dims) != len(self._meshes):
+            raise ValueError(
+                f"{len(dims)} shard_dims for {len(self._meshes)} meshes"
+            )
+        self._shard_dims = dims
+        # is_dataset_splitted means the user already split the dataset
+        # per rank; placement is identical either way here because the
+        # yielded value is the GLOBAL batch in the SPMD model.
+        self._is_dataset_splitted = bool(is_dataset_splitted)
+
+    def __len__(self):
+        return len(self._loader)
+
+    def _mesh_for(self, i):
+        # batches may carry more elements than meshes (sample ids,
+        # masks, ...): extras follow the LAST mesh, mirroring the
+        # reference's "all inputs on one mesh" default
+        i = min(i, len(self._meshes) - 1)
+        return self._meshes[i], self._shard_dims[i]
+
+    def _place(self, value, i):
+        mesh, dim = self._mesh_for(i)
+        if isinstance(value, (list, tuple)):
+            return type(value)(self._place(v, i) for v in value)
+        if not isinstance(value, Tensor):
+            return value
+        if value.is_dist():
+            return value
+        axis = _axis_index(mesh, dim)
+        placements = [Replicate()] * mesh.ndim
+        if axis is not None and value._data.ndim > 0:
+            size = mesh.shape[axis]
+            if value._data.shape[0] % size == 0:
+                placements[axis] = Shard(0)
+        return shard_tensor(
+            value, mesh, placements, stop_gradient=value.stop_gradient
+        )
+
+    def __iter__(self):
+        for batch in self._loader:
+            if isinstance(batch, dict):
+                keys = self._input_keys or list(batch.keys())
+                out = dict(batch)  # input_keys selects what to PLACE,
+                for i, k in enumerate(keys):  # never filters the batch
+                    out[k] = self._place(batch[k], i)
+                yield out
+            elif isinstance(batch, (list, tuple)):
+                yield type(batch)(
+                    self._place(v, i) for i, v in enumerate(batch)
+                )
+            else:
+                yield self._place(batch, 0)
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None,
+                     shard_dims=None, is_dataset_splitted=False):
+    """ref api.py:3301 — see ShardDataloader."""
+    return ShardDataloader(
+        dataloader, meshes, input_keys=input_keys, shard_dims=shard_dims,
+        is_dataset_splitted=is_dataset_splitted,
+    )

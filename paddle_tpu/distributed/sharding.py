@@ -193,12 +193,16 @@ def shard_optimizer(optimizer, shard_fn=None, gradient_accumulation_steps=1):
     Works with both eager ``opt.step()`` and ``jit.TrainStep`` (which picks
     up ``_grad_sharding_for`` to constrain gradient layout in-program).
     """
-    if gradient_accumulation_steps != 1:
-        raise NotImplementedError(
-            "gradient_accumulation_steps != 1 is not supported; accumulate "
-            "outside the optimizer (scale the loss by 1/k and step every k "
-            "micro-batches)"
+    # Recorded on the optimizer; jit.TrainStep reads it as the default
+    # accum_steps and stages the k-micro-batch scan + single update (the
+    # reference's gradient-merge pass,
+    # passes/auto_parallel_gradient_merge.py, as ONE compiled program).
+    k = int(gradient_accumulation_steps)
+    if k < 1:
+        raise ValueError(
+            f"gradient_accumulation_steps must be >= 1, got {k}"
         )
+    optimizer.gradient_accumulation_steps = k
     if shard_fn is None:
         return optimizer
 

@@ -350,13 +350,34 @@ class TrainStep:
     ``loss_fn(model, *args, **kwargs)`` runs the forward and returns the
     scalar loss; everything it does is staged. The LR schedule and
     GradScaler found_inf enter as scalar operands (no recompile per step).
+
+    ``accum_steps=k`` stages GRADIENT ACCUMULATION (the reference's
+    gradient-merge pass, distributed/passes/auto_parallel_gradient_merge.py)
+    as a ``lax.scan`` over k micro-batches: every data input's leading
+    batch axis is split [B] -> [k, B//k], the scan body runs fwd+bwd on
+    one micro-batch (so only ONE micro-batch's activations are ever
+    live), gradients accumulate in fp32 through the carry, and a single
+    optimizer update runs on the mean gradient — numerically the step a
+    k-times-larger batch would take. Composes with ZeRO: stage>=2
+    gradient shardings constrain the carry, so the running sum stays
+    reduce-scattered across the mesh inside the scan.
     """
 
-    def __init__(self, model, loss_fn, optimizer, donate=True):
+    def __init__(self, model, loss_fn, optimizer, donate=True,
+                 accum_steps=None):
         self._model = model
         self._loss_fn = loss_fn
         self._opt = optimizer
         self._donate = donate
+        if accum_steps is None:
+            accum_steps = getattr(
+                optimizer, "gradient_accumulation_steps", 1
+            )
+        self._accum = int(accum_steps)
+        if self._accum < 1:
+            raise ValueError(
+                f"accum_steps must be >= 1, got {accum_steps}"
+            )
         self._params = [
             p for p in optimizer._parameter_list
             if getattr(p, "trainable", not p.stop_gradient)
@@ -440,8 +461,133 @@ class TrainStep:
             return (new_param_arrays, new_buffer_arrays, out_states,
                     loss_val, new_key, nan_flags)
 
+        def staged_accum(param_arrays, buffer_arrays, states, lr, t,
+                         found_inf, key, tree_args):
+            """accum_steps>1: scan k micro-batches, one update."""
+            k = self._accum
+            old_p = _swap_payloads(params, param_arrays)
+            old_b = _swap_payloads(buffers, buffer_arrays)
+            saved = [(p.grad, p._grad_node, p._out_index, p.stop_gradient)
+                     for p in params]
+            try:
+                for p in params:
+                    p.grad = None
+                    p._grad_node = None
+                    p.stop_gradient = False
+
+                def split(a):
+                    if not hasattr(a, "shape") or a.ndim == 0:
+                        raise ValueError(
+                            "accum_steps requires every data input to "
+                            "have a leading batch axis to micro-split; "
+                            f"got {a!r}"
+                        )
+                    if a.shape[0] % k:
+                        raise ValueError(
+                            f"batch axis {a.shape[0]} not divisible by "
+                            f"accum_steps={k}"
+                        )
+                    return a.reshape((k, a.shape[0] // k) + a.shape[1:])
+
+                micro_tree = jax.tree_util.tree_map(split, tree_args)
+                keys = jax.random.split(key, k + 1)
+
+                # fp32 accumulators for every trainable param; ZeRO
+                # layouts constrain the carry so the running sum stays
+                # sharded through the scan
+                def g_init(i, a):
+                    dt = (jnp.float32 if a.dtype in (jnp.bfloat16,
+                                                     jnp.float16)
+                          else a.dtype)
+                    z = jnp.zeros(a.shape, dt)
+                    if (self._grad_shardings is not None
+                            and self._grad_shardings[i] is not None):
+                        z = jax.lax.with_sharding_constraint(
+                            z, self._grad_shardings[i]
+                        )
+                    return z
+
+                grad_acc0 = [g_init(i, a)
+                             for i, a in enumerate(param_arrays)]
+                live_holder = []
+
+                def body(carry, xs):
+                    grad_acc, bufs = carry
+                    mt, key_i = xs
+                    _swap_payloads(buffers, bufs)
+                    for p in params:
+                        p.grad = None
+                        p._grad_node = None
+                    net = _nan_net(outer._built_nan)
+                    with _rng_lift(key_i):
+                        args_i, kwargs_i = mt
+                        with net:
+                            loss = loss_fn(model, *args_i, **kwargs_i)
+                            loss.backward()
+                    li = [i for i, p in enumerate(params)
+                          if p.grad is not None]
+                    if not live_holder:
+                        live_holder.append(li)
+                        outer._nan_nets[outer._cur_nan_key] = net
+                    new_acc = list(grad_acc)
+                    for i in li:
+                        g = params[i].grad._data.astype(grad_acc[i].dtype)
+                        if (self._grad_shardings is not None
+                                and self._grad_shardings[i] is not None):
+                            g = jax.lax.with_sharding_constraint(
+                                g, self._grad_shardings[i]
+                            )
+                        new_acc[i] = grad_acc[i] + g
+                    new_bufs = [b._data for b in buffers]
+                    return ((new_acc, new_bufs),
+                            (loss._data, net.flags_output()))
+
+                (grad_acc, buf_fin), (losses, nan_stack) = jax.lax.scan(
+                    body, (grad_acc0, list(buffer_arrays)),
+                    (micro_tree, keys[1:]),
+                )
+                live_idx = live_holder[0]
+                if self._live_idx is None:
+                    self._live_idx = live_idx
+                live = [params[i] for i in live_idx]
+                attrs = tuple(self._attr_for(p) for p in live)
+                live_grads = [
+                    (grad_acc[i] * (1.0 / k)).astype(
+                        param_arrays[i].dtype
+                    )
+                    for i in live_idx
+                ]
+                targets = tuple(self._out_shardings[i] for i in live_idx)
+                new_live, new_states = opt_step_fn(
+                    attrs, targets, lr, t, found_inf,
+                    [params[i]._data for i in live_idx],
+                    live_grads,
+                    [states[i] for i in live_idx],
+                )
+                new_param_arrays = list(param_arrays)
+                out_states = list(states)
+                for j, i in enumerate(live_idx):
+                    new_param_arrays[i] = new_live[j]
+                    out_states[i] = new_states[j]
+                loss_val = losses.mean()
+                nan_flags = (
+                    nan_stack.any(axis=0) if nan_stack.size
+                    else jnp.zeros((0,), jnp.bool_)
+                )
+            finally:
+                _swap_payloads(params, [s for s in old_p])
+                _swap_payloads(buffers, old_b)
+                for p, (g, node, oi, sg) in zip(params, saved):
+                    p.grad = g
+                    p._grad_node = node
+                    p._out_index = oi
+                    p.stop_gradient = sg
+            return (new_param_arrays, list(buf_fin), out_states,
+                    loss_val, keys[0], nan_flags)
+
         donate = (0, 2) if self._donate else ()
-        return jax.jit(staged, donate_argnums=donate)
+        fn = staged if self._accum == 1 else staged_accum
+        return jax.jit(fn, donate_argnums=donate)
 
     def _attr_for(self, p):
         """Per-param static attrs, mirroring Optimizer._collect for one

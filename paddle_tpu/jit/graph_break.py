@@ -22,10 +22,15 @@ tracer), the function re-runs in segment mode:
 
 So `if loss > 0:` costs one segment boundary, and everything between
 boundaries still runs compiled — the SOT contract, expressed in dataflow
-instead of bytecode. Gradient taping composes with eager fallback only:
-when grads are required the function runs fully eager (correct, per-op);
-segment compilation is a no-grad fast path (the reference's SOT likewise
-falls back on unsupported features).
+instead of bytecode.
+
+GRADIENTS compose with segments (the reference's SOT compiles fwd+bwd
+partial programs around each break, partial_program.py): when grads are
+required, each flushed segment executes through dispatch.call as ONE
+tape op whose vjp is the transposed compiled segment. Segment inputs
+that were earlier segments' outputs are ordinary tape tensors, so
+cotangents stitch across the break points through the normal eager tape
+— loss.backward() after the call sees one GradNode per segment.
 """
 from __future__ import annotations
 
@@ -70,10 +75,12 @@ class _Deferred:
 class _Segment:
     """One pending compiled region: a straight-line op list."""
 
-    def __init__(self, owner):
+    def __init__(self, owner, grad_mode=False):
         self.owner = owner
+        self.grad_mode = grad_mode
         self.nodes = []        # (impl, flat_args, treedef, attrs, n_out)
         self.ext = []          # concrete external jax arrays
+        self.ext_tensors = []  # the Tensors behind ext (tape inputs)
         self.ext_ids = {}      # id(array) -> ext slot
         self.out_tensors = []  # deferred Tensors to fill on flush
         self.n_slots = 0
@@ -89,6 +96,7 @@ class _Segment:
             if key not in self.ext_ids:
                 self.ext_ids[key] = len(self.ext)
                 self.ext.append(arr)
+                self.ext_tensors.append(x)
             return ("ext", self.ext_ids[key])
         return ("const", x)
 
@@ -133,7 +141,9 @@ class _Segment:
             t = Tensor.__new__(Tensor)
             t.__init__(jax.numpy.zeros((), "float32"))  # placeholder init
             t._data = _Deferred(av, self, base + i)
-            t.stop_gradient = True
+            # grad mode: deferred outputs read as grad-requiring until
+            # the flush wires real tape nodes (flush overwrites this)
+            t.stop_gradient = not self.grad_mode
             self.out_tensors.append(t)
             outs.append(t)
         self.owner.stats["staged_ops"] += 1
@@ -188,13 +198,46 @@ class _Segment:
         if jitted is None:
             jitted = jax.jit(self.build_replay())
             self.owner._compile_cache[sig] = jitted
-        env = jitted(self.ext)
-        for t in self.out_tensors:
-            d = t._data
-            if isinstance(d, _Deferred):
-                t._data = env[d.slot]
+        want_grad = self.grad_mode and autograd.is_grad_enabled() and any(
+            not t.stop_gradient for t in self.ext_tensors
+            if isinstance(t, Tensor)
+        )
+        if want_grad:
+            # ONE tape op for the whole segment: jax.vjp of the jitted
+            # replay runs compiled in both directions; the dispatch hook
+            # must be off or the replay's call would be re-recorded
+            def seg_impl(ext):
+                return tuple(jitted(ext))
+
+            prev_hook = dispatch._segment_hook
+            dispatch._segment_hook = None
+            try:
+                outs = dispatch.call(
+                    "graph_segment", seg_impl,
+                    (list(self.ext_tensors),), {},
+                )
+            finally:
+                dispatch._segment_hook = prev_hook
+            outs = (list(outs) if isinstance(outs, (tuple, list))
+                    else [outs])
+            for t in self.out_tensors:
+                d = t._data
+                if isinstance(d, _Deferred):
+                    o = outs[d.slot]
+                    t._data = o._data
+                    t._grad_node = o._grad_node
+                    t._out_index = o._out_index
+                    t.stop_gradient = o.stop_gradient
+        else:
+            env = jitted(self.ext)
+            for t in self.out_tensors:
+                d = t._data
+                if isinstance(d, _Deferred):
+                    t._data = env[d.slot]
+                    t.stop_gradient = True
         self.owner.stats["segments"] += 1
         self.nodes, self.ext, self.ext_ids = [], [], {}
+        self.ext_tensors = []
         self.out_tensors, self.n_slots = [], 0
 
 
@@ -208,9 +251,9 @@ def _flush_get(tensor):
 class _segment_scope:
     """Install the dispatch + concretization hooks for one call."""
 
-    def __init__(self, owner):
+    def __init__(self, owner, grad_mode=False):
         self.owner = owner
-        self.segment = _Segment(owner)
+        self.segment = _Segment(owner, grad_mode=grad_mode)
 
     def __enter__(self):
         self._prev_hook = dispatch._segment_hook
@@ -261,22 +304,31 @@ class GraphBreakFunction:
                 self.stats["breaks"] += 1
 
         def _wants_grad(tree):
-            return any(
-                isinstance(v, Tensor) and not v.stop_gradient
-                for v in jax.tree_util.tree_leaves(
-                    tree, is_leaf=lambda x: isinstance(x, Tensor)
-                )
-            )
+            from ..nn.layer.layers import Layer
+
+            for v in jax.tree_util.tree_leaves(
+                tree, is_leaf=lambda x: isinstance(x, (Tensor, Layer))
+            ):
+                if isinstance(v, Tensor) and not v.stop_gradient:
+                    return True
+                if isinstance(v, Layer) and any(
+                    not p.stop_gradient for p in v.parameters()
+                ):
+                    return True
+            return False
 
         grads_needed = autograd.is_grad_enabled() and (
             any(not p.stop_gradient for p in (self._static._params or []))
             or _wants_grad((args, kwargs))
         )
         if grads_needed:
-            # taping + lazy segments don't compose; run fully eager
-            # (correct, uncompiled) — the reference's SOT likewise falls
-            # back to dygraph for unsupported features
-            self.stats["eager_calls"] += 1
-            return self._function(*args, **kwargs)
+            # segments still compile: each flush is one tape op (fwd
+            # compiled, vjp = transposed compiled segment), stitched by
+            # the eager tape across break points
+            self.stats["grad_segment_calls"] = (
+                self.stats.get("grad_segment_calls", 0) + 1
+            )
+            with _segment_scope(self, grad_mode=True):
+                return self._function(*args, **kwargs)
         with _segment_scope(self), autograd.no_grad():
             return self._function(*args, **kwargs)

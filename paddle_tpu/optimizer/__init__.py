@@ -7,6 +7,7 @@ from .adamax import Adamax
 from .adamw import AdamW
 from .asgd import ASGD
 from .lamb import Lamb
+from .lbfgs import LBFGS
 from .momentum import Momentum
 from .nadam import NAdam
 from .optimizer import Optimizer
@@ -25,7 +26,7 @@ __all__ = [
     "AdamW",
     "Adamax",
     "ASGD",
-    "Lamb",
+    "Lamb", "LBFGS",
     "NAdam",
     "RAdam",
     "RMSProp",

@@ -1,0 +1,276 @@
+"""L-BFGS (ref: python/paddle/optimizer/lbfgs.py — closure-driven step,
+two-loop recursion over a bounded (s, y) history, optional strong-Wolfe
+line search).
+
+TPU-native form: the closure re-evaluates loss+grads (eagerly or через a
+staged function); the two-loop recursion and the cubic-interpolation
+Wolfe search run on flattened jax arrays in ONE jit-compiled direction
+program per history length, so the math stays on device and only the
+line-search control flow is host-side (it is data-dependent by nature —
+the reference drives it from Python for the same reason).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+def _flatten(arrays):
+    return jnp.concatenate([a.reshape(-1).astype(jnp.float32)
+                            for a in arrays])
+
+
+@jax.jit
+def _two_loop(grad_flat, s_stack, y_stack, rho, h_diag):
+    """L-BFGS two-loop recursion on stacked history [m, n] (zero-padded
+    rows carry rho=0 and drop out of the sums)."""
+
+    def bwd(carry, inp):
+        q, = carry
+        s, y, r = inp
+        alpha = r * jnp.dot(s, q)
+        return (q - alpha * y,), alpha
+
+    (q,), alphas = jax.lax.scan(
+        bwd, (grad_flat,), (s_stack, y_stack, rho), reverse=True
+    )
+    r = q * h_diag
+
+    def fwd(carry, inp):
+        r_, = carry
+        s, y, rr, alpha = inp
+        beta = rr * jnp.dot(y, r_)
+        return (r_ + s * (alpha - beta),), None
+
+    (r,), _ = jax.lax.scan(fwd, (r,), (s_stack, y_stack, rho, alphas))
+    return -r
+
+
+def _cubic_min(x1, f1, g1, x2, f2, g2, lo, hi):
+    """Minimizer of the cubic through (x1,f1,g1),(x2,f2,g2), clamped to
+    [lo, hi]; bisection fallback on a degenerate discriminant."""
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    sq = d1 * d1 - g1 * g2
+    if sq < 0:
+        return (lo + hi) / 2.0
+    d2 = sq ** 0.5
+    if x1 <= x2:
+        t = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+    else:
+        t = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+    return min(max(t, lo), hi)
+
+
+class LBFGS(Optimizer):
+    """Closure-driven quasi-Newton optimizer (ref lbfgs.py:342).
+
+        opt = paddle.optimizer.LBFGS(parameters=m.parameters(),
+                                     line_search_fn='strong_wolfe')
+        def closure():
+            opt.clear_grad()
+            loss = loss_fn(m(x), y)
+            loss.backward()
+            return loss
+        loss = opt.step(closure)
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(
+            learning_rate=learning_rate, parameters=parameters,
+            weight_decay=weight_decay, grad_clip=grad_clip, name=name,
+        )
+        self.max_iter = int(max_iter)
+        self.max_eval = int(max_eval if max_eval is not None
+                            else max_iter * 5 // 4)
+        self.tolerance_grad = float(tolerance_grad)
+        self.tolerance_change = float(tolerance_change)
+        self.history_size = int(history_size)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError(
+                f"line_search_fn must be None or 'strong_wolfe', got "
+                f"{line_search_fn!r}"
+            )
+        self.line_search_fn = line_search_fn
+        # persistent across step() calls (the reference's self.state)
+        self._hist_s: list = []
+        self._hist_y: list = []
+        self._prev_grad = None
+        self._prev_loss = None
+        self._func_evals = 0
+
+    # -- flat-view helpers --------------------------------------------------
+    def _params(self):
+        return [p for p in self._parameter_list
+                if getattr(p, "trainable", not p.stop_gradient)]
+
+    def _gather_flat_grad(self):
+        gs = []
+        for p in self._params():
+            g = p.grad._data if p.grad is not None else jnp.zeros_like(
+                p._data
+            )
+            gs.append(g)
+        return _flatten(gs)
+
+    def _set_flat_params(self, flat):
+        offset = 0
+        with autograd.no_grad():
+            for p in self._params():
+                n = int(p._data.size)
+                chunk = flat[offset:offset + n].reshape(p._data.shape)
+                p._rebind(chunk.astype(p._data.dtype))
+                offset += n
+
+    def _direction(self, grad_flat):
+        m = len(self._hist_s)
+        if m == 0:
+            return -grad_flat
+        cap = self.history_size
+        s_stack = jnp.stack(self._hist_s[-cap:])
+        y_stack = jnp.stack(self._hist_y[-cap:])
+        rho = 1.0 / jnp.maximum(
+            jnp.einsum("mn,mn->m", s_stack, y_stack), 1e-10
+        )
+        h_diag = jnp.dot(self._hist_s[-1], self._hist_y[-1]) / jnp.maximum(
+            jnp.dot(self._hist_y[-1], self._hist_y[-1]), 1e-10
+        )
+        return _two_loop(grad_flat, s_stack, y_stack, rho, h_diag)
+
+    # -- strong Wolfe line search (host-driven; data-dependent) -------------
+    def _strong_wolfe(self, eval_fn, x0, loss0, grad0, d, alpha0,
+                      c1=1e-4, c2=0.9, max_ls=25):
+        dg0 = float(jnp.dot(grad0, d))
+        if dg0 >= 0:
+            return alpha0, loss0, grad0  # not a descent direction
+        a_prev, f_prev, g_prev = 0.0, loss0, dg0
+        a, f_lo, a_lo, g_lo = alpha0, loss0, 0.0, dg0
+        grad_a = grad0
+        bracketed = False
+        for _ in range(max_ls):
+            f_a, grad_a = eval_fn(x0 + a * d)
+            dg_a = float(jnp.dot(grad_a, d))
+            if f_a > loss0 + c1 * a * dg0 or (bracketed and f_a >= f_prev):
+                hi, f_hi, g_hi = a, f_a, dg_a
+                lo, f_lo, g_lo = a_prev, f_prev, g_prev
+                break
+            if abs(dg_a) <= -c2 * dg0:
+                return a, f_a, grad_a
+            if dg_a >= 0:
+                hi, f_hi, g_hi = a_prev, f_prev, g_prev
+                lo, f_lo, g_lo = a, f_a, dg_a
+                break
+            a_prev, f_prev, g_prev = a, f_a, dg_a
+            a = a * 2.0
+            bracketed = True
+        else:
+            return a, f_a, grad_a
+        # zoom between lo and hi
+        for _ in range(max_ls):
+            a = _cubic_min(lo, f_lo, g_lo, hi, f_hi, g_hi,
+                           min(lo, hi) + 0.1 * abs(hi - lo),
+                           max(lo, hi) - 0.1 * abs(hi - lo))
+            f_a, grad_a = eval_fn(x0 + a * d)
+            dg_a = float(jnp.dot(grad_a, d))
+            if f_a > loss0 + c1 * a * dg0 or f_a >= f_lo:
+                hi, f_hi, g_hi = a, f_a, dg_a
+            else:
+                if abs(dg_a) <= -c2 * dg0:
+                    return a, f_a, grad_a
+                if dg_a * (hi - lo) >= 0:
+                    hi, f_hi, g_hi = lo, f_lo, g_lo
+                lo, f_lo, g_lo = a, f_a, dg_a
+            if abs(hi - lo) < self.tolerance_change:
+                break
+        return a, f_a, grad_a
+
+    # -- the closure-driven step (ref lbfgs.py:582) -------------------------
+    def step(self, closure=None):
+        if closure is None:
+            raise TypeError(
+                "LBFGS.step requires a closure that re-evaluates the "
+                "model and returns the loss"
+            )
+
+        def evaluate():
+            with autograd.enable_grad():
+                loss = closure()
+            self._func_evals += 1
+            return float(loss.numpy()), self._gather_flat_grad()
+
+        def eval_at(flat_x):
+            self._set_flat_params(flat_x)
+            return evaluate()
+
+        loss, grad = evaluate()
+        orig_loss = loss
+        x = _flatten([p._data for p in self._params()])
+        lr = float(self.get_lr())
+
+        for it in range(self.max_iter):
+            if float(jnp.max(jnp.abs(grad))) <= self.tolerance_grad:
+                break
+            d = self._direction(grad)
+            # first-ever iteration scales like the reference:
+            # min(1, 1/|g|_1) * lr
+            if not self._hist_s and it == 0:
+                alpha = min(1.0, 1.0 / max(
+                    float(jnp.sum(jnp.abs(grad))), 1e-10)) * lr
+            else:
+                alpha = lr
+            prev_x, prev_grad, prev_loss = x, grad, loss
+            if self.line_search_fn == "strong_wolfe":
+                alpha, loss, grad = self._strong_wolfe(
+                    eval_at, x, loss, grad, d, alpha
+                )
+                x = prev_x + alpha * d
+                self._set_flat_params(x)
+            else:
+                x = x + alpha * d
+                self._set_flat_params(x)
+                loss, grad = evaluate()
+            s = x - prev_x
+            y = grad - prev_grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._hist_s.append(s)
+                self._hist_y.append(y)
+                if len(self._hist_s) > self.history_size:
+                    self._hist_s.pop(0)
+                    self._hist_y.pop(0)
+            if self._func_evals >= self.max_eval:
+                break
+            if (float(jnp.max(jnp.abs(alpha * d)))
+                    <= self.tolerance_change):
+                break
+            if abs(loss - prev_loss) < self.tolerance_change:
+                break
+
+        self._global_step += 1
+        return Tensor(jnp.float32(orig_loss), stop_gradient=True)
+
+    def _update(self, p, g, state, lr, t, attr):  # pragma: no cover
+        raise RuntimeError(
+            "LBFGS is closure-driven; call step(closure), not step()"
+        )
+
+    def state_dict(self):
+        return {
+            "hist_s": list(self._hist_s),
+            "hist_y": list(self._hist_y),
+            "func_evals": self._func_evals,
+            "global_step": self._global_step,
+        }
+
+    def set_state_dict(self, state_dict):
+        self._hist_s = list(state_dict.get("hist_s", []))
+        self._hist_y = list(state_dict.get("hist_y", []))
+        self._func_evals = int(state_dict.get("func_evals", 0))
+        self._global_step = int(state_dict.get("global_step", 0))

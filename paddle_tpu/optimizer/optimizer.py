@@ -340,6 +340,14 @@ class Optimizer:
 
     @autograd.no_grad()
     def step(self):
+        if getattr(self, "gradient_accumulation_steps", 1) > 1:
+            raise RuntimeError(
+                "gradient_accumulation_steps is set on this optimizer "
+                "but eager step() does not accumulate — run the step "
+                "through paddle.jit.TrainStep (it stages the k-micro-"
+                "batch accumulation + single update), or unset the "
+                "attribute to step eagerly per batch"
+            )
         triples = self._collect()
         if not triples:
             self._global_step += 1

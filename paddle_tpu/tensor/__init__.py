@@ -1,0 +1,18 @@
+"""paddle.tensor namespace (ref: python/paddle/tensor/__init__.py —
+the functional tensor surface plus the TensorArray helpers from
+tensor/array.py)."""
+from ..core.aux_tensors import (
+    StringTensor,
+    TensorArray,
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+)
+from ..ops.api import *  # noqa: F401,F403
+from ..ops.api import __all__ as _ops_all
+
+__all__ = list(_ops_all) + [
+    "TensorArray", "StringTensor", "create_array", "array_write",
+    "array_read", "array_length",
+]

@@ -1,0 +1,164 @@
+"""Gradient accumulation (TrainStep accum_steps) parity.
+
+ref contract: the gradient-merge pass
+(distributed/passes/auto_parallel_gradient_merge.py) — k micro-batches
+accumulated then one update must equal the step a k-times-larger batch
+takes. Oracle: TrainStep accum_steps=1 on the full batch.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(
+        nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 4)
+    )
+
+
+def _loss_fn(m, x, y):
+    out = m(x)
+    return ((out - y) ** 2).mean()
+
+
+def _llama_loss(m, ids):
+    _, loss = m(ids, labels=ids)
+    return loss
+
+
+class TestGradAccumParity:
+    def test_accum_equals_big_batch(self):
+        """k accumulated micro-batches == one k*B step (params bitwise
+        close; loss identical up to mean-of-means)."""
+        x = np.random.RandomState(0).randn(8, 16).astype("float32")
+        y = np.random.RandomState(1).randn(8, 4).astype("float32")
+
+        def run(accum):
+            m = _mlp()
+            opt = paddle.optimizer.AdamW(
+                learning_rate=1e-2, parameters=m.parameters()
+            )
+            step = paddle.jit.TrainStep(
+                m, _loss_fn, opt, donate=False, accum_steps=accum
+            )
+            losses = [
+                float(step(paddle.to_tensor(x),
+                           paddle.to_tensor(y)).numpy())
+                for _ in range(3)
+            ]
+            return losses, [p.numpy() for p in m.parameters()]
+
+        ref_losses, ref_params = run(1)
+        acc_losses, acc_params = run(4)
+        np.testing.assert_allclose(acc_losses, ref_losses, rtol=1e-5)
+        for a, b in zip(acc_params, ref_params):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_accum_on_llama_with_clip(self):
+        """Grad clipping sees the MEAN accumulated gradient (same global
+        norm as the big batch) — loss trajectories must match."""
+        cfg = LlamaConfig.tiny(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+        )
+        ids = np.random.RandomState(0).randint(
+            0, 64, (8, 12)
+        ).astype("int64")
+
+        def run(accum):
+            paddle.seed(0)
+            m = LlamaForCausalLM(cfg)
+            opt = paddle.optimizer.AdamW(
+                learning_rate=1e-2, parameters=m.parameters(),
+                grad_clip=nn.ClipGradByGlobalNorm(1.0),
+            )
+            step = paddle.jit.TrainStep(
+                m, _llama_loss, opt, donate=False, accum_steps=accum
+            )
+            return [
+                float(step(paddle.to_tensor(ids)).numpy())
+                for _ in range(3)
+            ]
+
+        np.testing.assert_allclose(
+            run(2), run(1), rtol=2e-4
+        )
+
+    def test_batch_not_divisible_raises(self):
+        m = _mlp()
+        opt = paddle.optimizer.AdamW(
+            learning_rate=1e-2, parameters=m.parameters()
+        )
+        step = paddle.jit.TrainStep(m, _loss_fn, opt, donate=False,
+                                    accum_steps=3)
+        x = paddle.to_tensor(np.zeros((8, 16), "float32"))
+        y = paddle.to_tensor(np.zeros((8, 4), "float32"))
+        with pytest.raises(ValueError, match="not divisible"):
+            step(x, y)
+
+    def test_bad_accum_steps_raises(self):
+        m = _mlp()
+        opt = paddle.optimizer.AdamW(
+            learning_rate=1e-2, parameters=m.parameters()
+        )
+        with pytest.raises(ValueError, match="accum_steps"):
+            paddle.jit.TrainStep(m, _loss_fn, opt, accum_steps=0)
+
+
+class TestGradAccumZeRO:
+    def test_accum_composes_with_sharding_stage2(self):
+        """shard_optimizer(gradient_accumulation_steps=k) + ZeRO-2:
+        TrainStep picks up k from the optimizer, the accumulated-grad
+        carry stays sharded, and the loss matches the unsharded
+        big-batch oracle."""
+        from paddle_tpu.distributed.sharding import (
+            ShardingStage2, shard_optimizer,
+        )
+
+        cfg = LlamaConfig.tiny(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+        )
+        ids = np.random.RandomState(3).randint(
+            0, 64, (8, 12)
+        ).astype("int64")
+
+        def ref():
+            paddle.seed(0)
+            m = LlamaForCausalLM(cfg)
+            opt = paddle.optimizer.AdamW(
+                learning_rate=1e-2, parameters=m.parameters()
+            )
+            step = paddle.jit.TrainStep(m, _llama_loss, opt,
+                                        donate=False)
+            return [
+                float(step(paddle.to_tensor(ids)).numpy())
+                for _ in range(2)
+            ]
+
+        def sharded():
+            mesh = dist.ProcessMesh(list(range(8)), ["dp"])
+            paddle.seed(0)
+            m = LlamaForCausalLM(cfg)
+            opt = paddle.optimizer.AdamW(
+                learning_rate=1e-2, parameters=m.parameters()
+            )
+            opt = shard_optimizer(
+                opt, ShardingStage2("dp", mesh),
+                gradient_accumulation_steps=2,
+            )
+            assert opt.gradient_accumulation_steps == 2
+            step = paddle.jit.TrainStep(m, _llama_loss, opt,
+                                        donate=False)
+            assert step._accum == 2
+            return [
+                float(step(paddle.to_tensor(ids)).numpy())
+                for _ in range(2)
+            ]
+
+        np.testing.assert_allclose(sharded(), ref(), rtol=2e-4)

@@ -129,7 +129,9 @@ class TestGraphBreak:
         assert g is not None
         assert np.isfinite(g.numpy()).all()
         assert isinstance(net.forward, GraphBreakFunction)
-        assert net.forward.stats["eager_calls"] >= 1
+        # grads now run through compiled segments, not per-op eager
+        assert net.forward.stats["grad_segment_calls"] >= 1
+        assert net.forward.stats["segments"] >= 1
 
     def test_plain_function_trainable_input_falls_back_eager(self):
         # grads through a broken plain function must NOT be silently
@@ -149,7 +151,55 @@ class TestGraphBreak:
         out.sum().backward()
         assert x2.grad is not None
         np.testing.assert_allclose(x2.grad.numpy(), [2.0, 2.0])
-        assert fn.stats["eager_calls"] >= 1
+        assert fn.stats["grad_segment_calls"] >= 1
+
+    def test_training_with_data_dependent_loss_matches_eager(self):
+        """VERDICT r4 item 5 'done' case: a data-dependent `if` in the
+        LOSS, trained for several steps — parameter trajectories must
+        match pure eager (the oracle), while the broken segments still
+        run compiled (segments recorded, no eager_calls)."""
+        import paddle_tpu.nn as nn
+
+        def build():
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(),
+                                nn.Linear(8, 1))
+            opt = paddle.optimizer.SGD(
+                learning_rate=0.1, parameters=net.parameters()
+            )
+            return net, opt
+
+        xs = np.random.RandomState(3).randn(6, 4).astype("float32")
+        ys = np.random.RandomState(4).randn(6, 1).astype("float32")
+
+        def loss_py(net, x, y):
+            err = net(x) - y
+            loss = (err ** 2).mean()
+            if float(loss.numpy()) > 0.5:   # data-dependent break
+                loss = loss * 0.5
+            return loss
+
+        def run(wrap):
+            net, opt = build()
+            fn = (paddle.jit.to_static(loss_py, full_graph=False)
+                  if wrap else loss_py)
+            traj = []
+            for _ in range(4):
+                loss = fn(net, _t(xs), _t(ys))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                traj.append(float(loss.numpy()))
+            return traj, [p.numpy() for p in net.parameters()], fn
+
+        ref_traj, ref_params, _ = run(False)
+        got_traj, got_params, fn = run(True)
+        np.testing.assert_allclose(got_traj, ref_traj, rtol=1e-5)
+        for a, b in zip(got_params, ref_params):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+        assert fn.stats["grad_segment_calls"] >= 1
+        assert fn.stats["segments"] >= 2  # break splits the loss
+        assert fn.stats["eager_calls"] == 0
 
     def test_full_graph_true_still_raises(self):
         def branchy(x):

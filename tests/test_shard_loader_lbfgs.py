@@ -1,0 +1,191 @@
+"""shard_dataloader + async checkpoint save + LBFGS.
+
+ref contracts: distributed/auto_parallel/api.py:3301 (shard_dataloader),
+distributed/checkpoint/save_state_dict.py:46 (async save queue + flush),
+optimizer/lbfgs.py:342 (closure-driven LBFGS with strong-Wolfe search).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.io import DataLoader, TensorDataset
+
+
+def _loader(n=16, batch=8):
+    xs = np.random.RandomState(0).randn(n, 4).astype("float32")
+    ys = np.arange(n).astype("int64")
+    ds = TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+    return DataLoader(ds, batch_size=batch, shuffle=False,
+                      num_workers=0)
+
+
+class TestShardDataloader:
+    def test_batches_are_dp_sharded(self):
+        mesh = dist.ProcessMesh(
+            np.arange(8).reshape(2, 4), ["dp", "tp"]
+        )
+        sl = dist.shard_dataloader(_loader(), mesh, shard_dims="dp")
+        batches = list(sl)
+        assert len(batches) == len(_loader())
+        x, y = batches[0]
+        assert x.is_dist() and y.is_dist()
+        # batch axis sharded over dp, replicated over tp
+        assert x._dist_meta.placements[0].is_shard()
+        assert x._dist_meta.placements[1].is_replicate()
+        # global view unchanged
+        assert tuple(x.shape) == (8, 4)
+
+    def test_default_is_replicated(self):
+        mesh = dist.ProcessMesh(list(range(8)), ["dp"])
+        sl = dist.shard_dataloader(_loader(), mesh)
+        x, _ = next(iter(sl))
+        assert x.is_dist()
+        assert all(p.is_replicate() for p in x._dist_meta.placements)
+
+    def test_dict_batches_with_input_keys(self):
+        mesh = dist.ProcessMesh(list(range(8)), ["dp"])
+
+        class DictLoader:
+            def __len__(self):
+                return 2
+
+            def __iter__(self):
+                for _ in range(2):
+                    yield {
+                        "input": paddle.to_tensor(
+                            np.zeros((8, 4), "float32")
+                        ),
+                        "label": paddle.to_tensor(
+                            np.zeros((8,), "int64")
+                        ),
+                    }
+
+        sl = dist.shard_dataloader(
+            DictLoader(), [mesh, mesh],
+            input_keys=["input", "label"], shard_dims="dp",
+        )
+        b = next(iter(sl))
+        assert b["input"].is_dist() and b["label"].is_dist()
+        assert b["input"]._dist_meta.placements[0].is_shard()
+
+    def test_trains_through_train_step(self):
+        mesh = dist.ProcessMesh(np.arange(8).reshape(8, 1), ["dp", "mp"])
+        paddle.seed(0)
+        m = nn.Linear(4, 3)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        sl = dist.shard_dataloader(_loader(), mesh, shard_dims="dp")
+
+        def loss_fn(model, x, y):
+            import paddle_tpu.nn.functional as F
+
+            return F.cross_entropy(model(x), y % 3).mean()
+
+        step = paddle.jit.TrainStep(m, loss_fn, opt, donate=False)
+        for x, y in sl:
+            loss = step(x, y)
+        assert np.isfinite(float(loss.numpy()))
+
+
+class TestAsyncCheckpoint:
+    def test_async_save_flush_and_reload(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import (
+            load_state_dict, save_state_dict, wait_async_save,
+        )
+
+        mesh = dist.ProcessMesh(list(range(8)), ["dp"])
+        w = dist.shard_tensor(
+            paddle.to_tensor(
+                np.arange(32, dtype="float32").reshape(8, 4)
+            ),
+            mesh, [dist.Shard(0)],
+        )
+        sd = {"w": w, "step": 7}
+        path = str(tmp_path / "ckpt")
+        save_state_dict(sd, path, async_save=True)
+        wait_async_save()  # flush barrier
+        assert os.path.exists(os.path.join(path, "data.npz"))
+
+        target = {
+            "w": dist.shard_tensor(
+                paddle.to_tensor(np.zeros((8, 4), "float32")),
+                mesh, [dist.Replicate()],
+            ),
+            "step": 0,
+        }
+        out = load_state_dict(target, path)
+        got = out["w"] if isinstance(out, dict) else target["w"]
+        np.testing.assert_allclose(
+            np.asarray(dist.to_global_array(got)),
+            np.arange(32, dtype="float32").reshape(8, 4),
+        )
+
+    def test_async_save_overwrite_after_snapshot(self, tmp_path):
+        """The snapshot is taken at call time: mutating the param right
+        after save must not corrupt the checkpoint."""
+        from paddle_tpu.distributed.checkpoint import (
+            save_state_dict, wait_async_save,
+        )
+
+        w = paddle.to_tensor(np.ones((4,), "float32"))
+        path = str(tmp_path / "ckpt2")
+        save_state_dict({"w": w}, path, async_save=True)
+        w._rebind(paddle.to_tensor(np.zeros((4,), "float32"))._data)
+        wait_async_save()
+        data = np.load(os.path.join(path, "data.npz"))
+        np.testing.assert_allclose(data["w"], np.ones(4))
+
+
+class TestLBFGS:
+    def test_rosenbrock_converges(self):
+        """Classic quasi-Newton benchmark: LBFGS reaches the (1,1)
+        optimum where SGD at the same eval budget cannot."""
+        p = paddle.to_tensor(np.array([-1.2, 1.0], "float32"))
+        p.stop_gradient = False
+        opt = paddle.optimizer.LBFGS(
+            parameters=[p], learning_rate=1.0, max_iter=40,
+            line_search_fn="strong_wolfe",
+        )
+
+        def closure():
+            opt.clear_grad()
+            x, y = p[0], p[1]
+            loss = (1 - x) ** 2 + 100 * (y - x ** 2) ** 2
+            loss.backward()
+            return loss
+
+        for _ in range(8):
+            opt.step(closure)
+        final = p.numpy()
+        np.testing.assert_allclose(final, [1.0, 1.0], atol=1e-2)
+
+    def test_quadratic_one_call(self):
+        paddle.seed(0)
+        m = nn.Linear(3, 1)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(16, 3)
+                             .astype("float32"))
+        w_true = np.array([[1.0], [-2.0], [0.5]], "float32")
+        y = paddle.to_tensor(x.numpy() @ w_true + 0.3)
+        opt = paddle.optimizer.LBFGS(parameters=m.parameters(),
+                                     max_iter=30)
+
+        def closure():
+            opt.clear_grad()
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            return loss
+
+        for _ in range(5):
+            opt.step(closure)
+        final = float(closure().numpy())
+        assert final < 1e-3, final
+
+    def test_requires_closure(self):
+        m = nn.Linear(2, 1)
+        opt = paddle.optimizer.LBFGS(parameters=m.parameters())
+        with pytest.raises(TypeError, match="closure"):
+            opt.step()
