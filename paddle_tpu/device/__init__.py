@@ -21,6 +21,7 @@ from ..core.device import (  # noqa: F401
 )
 
 __all__ = [
+    "plugin",
     "set_device", "get_device", "device_count", "is_compiled_with_tpu",
     "max_memory_allocated", "max_memory_reserved", "memory_allocated",
     "memory_reserved", "reset_max_memory_allocated", "empty_cache",
@@ -111,3 +112,5 @@ class cuda:
     @staticmethod
     def device_count():
         return device_count()
+
+from . import plugin  # noqa: E402  (custom-device C-ABI analogue)

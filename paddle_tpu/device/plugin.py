@@ -1,0 +1,99 @@
+"""Custom-device plugin registration (CustomDevice / XCCL analogue).
+
+ref: paddle/phi/backends/device_ext.h:95 (C_DeviceInterface — the
+reference's C-ABI plugin table: device manage, memory, stream, event,
+XCCL collective hooks) and custom_device.cc which adapts it into phi.
+
+TPU-native mapping: in the XLA world the custom-device C ABI IS the
+PJRT C API (pjrt_c_api.h) — a vendor ships `libfoo_pjrt.so` exporting
+``GetPjrtApi``; jax loads it and every paddle_tpu op/collective runs on
+the new backend unchanged, because compute lowers through XLA and
+collectives lower through GSPMD (the reference's per-op custom-kernel
+and XCCL registration tables have no work left to do here). This module
+is the registration surface:
+
+    paddle.device.plugin.register_custom_device(
+        "foo", "/path/libfoo_pjrt.so")
+    paddle.set_device("foo")           # devices enumerate via jax
+
+The reference loads plugins from CUSTOM_DEVICE_ROOT at import; the
+analogue PADDLE_PJRT_PLUGINS=name=path[,name=path...] is honored on
+import of paddle_tpu.device.plugin.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = [
+    "register_custom_device", "list_custom_devices",
+    "is_custom_device_available",
+]
+
+_registered: dict[str, str] = {}
+
+
+def register_custom_device(name: str, library_path: str,
+                           options: dict | None = None):
+    """Register a PJRT plugin as backend `name` (ref device_ext.h's
+    plugin entry point + custom_device_load in the reference runtime).
+
+    The .so must export the PJRT C API (``GetPjrtApi``). Registration
+    must happen BEFORE the first jax computation — the same constraint
+    the reference has (plugins load before DeviceManager init)."""
+    if not os.path.exists(library_path):
+        raise FileNotFoundError(
+            f"custom device plugin not found: {library_path}"
+        )
+    import jax
+    import jax._src.xla_bridge as xb
+
+    if name in _registered:
+        return
+    xb.register_plugin(
+        name, library_path=library_path, options=options or {}
+    )
+    _registered[name] = library_path
+    # surface the new platform unless the user pinned one
+    if not os.environ.get("JAX_PLATFORMS"):
+        try:
+            jax.config.update("jax_platforms", None)
+        except Exception:
+            pass
+
+
+def list_custom_devices():
+    """Names registered through register_custom_device (ref
+    get_all_custom_device_type)."""
+    return sorted(_registered)
+
+
+def is_custom_device_available(name: str) -> bool:
+    """True when the plugin registered AND its devices enumerate."""
+    if name not in _registered:
+        return False
+    try:
+        import jax
+
+        return len(jax.devices(name)) > 0
+    except Exception:
+        return False
+
+
+def _load_env_plugins():
+    """PADDLE_PJRT_PLUGINS=name=path[,name=path] — the analogue of the
+    reference scanning CUSTOM_DEVICE_ROOT at import."""
+    spec = os.environ.get("PADDLE_PJRT_PLUGINS", "")
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        if "=" not in part:
+            continue
+        name, path = part.split("=", 1)
+        try:
+            register_custom_device(name.strip(), path.strip())
+        except Exception as e:  # never break import on a bad plugin
+            import sys
+
+            print(f"[paddle_tpu] custom device {name!r} failed to "
+                  f"register: {e}", file=sys.stderr)
+
+
+_load_env_plugins()

@@ -10,9 +10,20 @@ with one scatter and reads it back with one gather — O(s*k*m) routing
 memory instead of the dense GShard one-hot formulation's O(s*e*c)
 dispatch/combine tensors (which this layer used before, and which
 TopKGate.forward still provides for compatibility). The expert FFN is a
-grouped GEMM over the stacked [E, ...] weights; sharding E over an 'ep'
-mesh axis makes GSPMD insert the dispatch/combine all-to-alls the
-reference launches by hand (global_scatter/global_gather).
+grouped GEMM over the stacked [E, ...] weights — the einsum batches all
+experts' projections into single [e, c, f] MXU contractions, the XLA
+analogue of fused_moe_kernel.cu's grouped cutlass GEMMs; sharding E over
+an 'ep' mesh axis makes GSPMD insert the dispatch/combine all-to-alls
+the reference launches by hand (global_scatter/global_gather).
+
+Measured (r5, 1x v5e, BASELINE.md): the Mixtral-style bench config
+(653M total / 238M active, e=8 k=2, L=8, batch 8 x seq 1024, donated
+AdamW step) runs 319 ms/step = 25.7k tokens/s = 18.6% active-MFU —
+capacity padding (factor 1.25) bounds the wasted expert FLOPs at ~25%,
+so the padded grouped GEMM stays MXU-bound rather than
+gather/scatter-bound. (r4's "0.4% MFU / superlinear depth cost" was a
+measurement artifact: the timing window landed in the tunnel's slow
+settle phase — see BASELINE.md r5.)
 """
 from __future__ import annotations
 

@@ -165,3 +165,41 @@ def rfftfreq(*, n, d=1.0, dtype=None):
     return out.astype(to_jnp(dtype)) if dtype is not None else (
         out.astype(jnp.float32)
     )
+
+
+# ---- r5 signal framing (ref python/paddle/signal.py) ---------------------
+def frame(x, *, frame_length, hop_length, axis=-1):
+    """Slice overlapping frames along `axis` (ref signal.frame)."""
+    import jax.numpy as jnp
+
+    n = x.shape[axis]
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # [num, fl]
+    framed = jnp.take(x, idx.reshape(-1), axis=axis)
+    shape = list(x.shape)
+    shape[axis if axis >= 0 else x.ndim + axis] = num
+    framed = framed.reshape(
+        tuple(shape[:axis if axis >= 0 else x.ndim + axis])
+        + (num, frame_length)
+        + tuple(shape[(axis if axis >= 0 else x.ndim + axis) + 1:])
+    )
+    # ref layout: frame_length BEFORE num_frames on the last two dims
+    return jnp.swapaxes(framed, -1, -2) if axis in (-1, x.ndim - 1) \
+        else framed
+
+
+def overlap_add(x, *, hop_length, axis=-1):
+    """Inverse of frame for the [-2, -1] = (frame_length, num) layout
+    (ref signal.overlap_add)."""
+    import jax.numpy as jnp
+
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError("overlap_add supports axis=-1")
+    fl, num = x.shape[-2], x.shape[-1]
+    n_out = fl + hop_length * (num - 1)
+    # one scatter-add: duplicate target indices accumulate, so the whole
+    # overlap-add is a single [fl, num] indexed .add (no unrolled loop)
+    idx = (jnp.arange(num) * hop_length)[None, :] +         jnp.arange(fl)[:, None]
+    out = jnp.zeros(x.shape[:-2] + (n_out,), x.dtype)
+    return out.at[..., idx].add(x)

@@ -271,3 +271,58 @@ def pca_lowrank(x, *, q=None, center=True, niter=2):
     a = x - jnp.mean(x, axis=-2, keepdims=True) if center else x
     u, s, vh = jnp.linalg.svd(a, full_matrices=False)
     return u[..., :q], s[..., :q], jnp.swapaxes(vh, -1, -2)[..., :q]
+
+
+# ---- r5 breadth additions ------------------------------------------------
+def lu_unpack(x, y, *, unpack_ludata=True, unpack_pivots=True):
+    """Unpack lu() results into (P, L, U) (ref tensor/linalg.py
+    lu_unpack; pivots are 1-based like the reference's). Batched inputs
+    vmap over the leading dims."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(x2, piv1):
+        m, n = x2.shape
+        k = min(m, n)
+        L = jnp.tril(x2[:, :k], -1) + jnp.eye(m, k, dtype=x2.dtype)
+        U = jnp.triu(x2[:k, :])
+        perm = jnp.arange(m)
+        piv = piv1.astype(jnp.int32) - 1
+
+        def body(p, i):
+            a = p[i]
+            b = p[piv[i]]
+            p = p.at[i].set(b).at[piv[i]].set(a)
+            return p, None
+
+        perm, _ = jax.lax.scan(body, perm, jnp.arange(piv.shape[-1]))
+        P = jnp.eye(m, dtype=x2.dtype)[perm].T
+        return P, L, U
+
+    fn = one
+    for _ in range(x.ndim - 2):
+        fn = jax.vmap(fn)
+    return fn(x, y)
+
+
+def p_norm(x, *, porder=2.0, axis=None, epsilon=1e-12, keepdim=False,
+           asvector=False):
+    """ref tensor/linalg p_norm — vector p-norm along axis (the whole
+    flattened tensor when asvector/axis None)."""
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    if axis is None or asvector:
+        xf = xf.reshape(-1)
+        axis = 0
+    if porder == float("inf"):
+        out = jnp.max(jnp.abs(xf), axis=axis, keepdims=keepdim)
+    elif porder == float("-inf"):
+        out = jnp.min(jnp.abs(xf), axis=axis, keepdims=keepdim)
+    elif porder == 0:
+        out = jnp.sum((xf != 0).astype(jnp.float32), axis=axis,
+                      keepdims=keepdim)
+    else:
+        out = jnp.sum(jnp.abs(xf) ** porder, axis=axis,
+                      keepdims=keepdim) ** (1.0 / porder)
+    return (out + 0.0).astype(x.dtype)

@@ -531,3 +531,48 @@ def slice_scatter(x, value, *, axes=(), starts=(), ends=(), strides=()):
     for a, s, e, st in zip(axes, starts, ends, strides):
         idx[a] = builtins_slice(int(s), int(e), int(st))
     return x.at[tuple(idx)].set(value.astype(x.dtype))
+
+
+# ---- r5 breadth additions ------------------------------------------------
+def as_strided(x, *, shape, stride, offset=0):
+    """Functional as_strided (ref tensor/manipulation.py as_strided):
+    gathers the strided view into a fresh tensor — XLA has no aliasing,
+    so the VIEW semantics become a copy with identical values."""
+    flat = x.reshape(-1)
+    idx = jnp.asarray(offset)
+    for size, st in zip(shape, stride):
+        idx = idx[..., None] + jnp.arange(size) * st
+    return flat[idx.reshape(-1)].reshape(tuple(shape))
+
+
+def channel_shuffle(x, *, groups, data_format="NCHW"):
+    if data_format == "NHWC":
+        n, h, w, c = x.shape
+        y = x.reshape(n, h, w, groups, c // groups)
+        return jnp.swapaxes(y, 3, 4).reshape(n, h, w, c)
+    n, c, h, w = x.shape
+    y = x.reshape(n, groups, c // groups, h, w)
+    return jnp.swapaxes(y, 1, 2).reshape(n, c, h, w)
+
+
+def temporal_shift(x, *, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """ref nn/functional/temporal_shift: shift a fraction of channels
+    one step forward/backward along the segment axis."""
+    if data_format == "NHWC":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    v = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    back = jnp.concatenate(
+        [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1
+    )
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(v[:, :1, fold:2 * fold]),
+         v[:, :-1, fold:2 * fold]], axis=1
+    )
+    out = jnp.concatenate([back, fwd, v[:, :, 2 * fold:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out

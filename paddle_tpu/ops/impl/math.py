@@ -367,3 +367,66 @@ def diff(x, *, n=1, axis=-1):
 
 def signbit(x):
     return jnp.signbit(x)
+
+
+# ---- r5 breadth additions (ref python/paddle/tensor/math.py) -------------
+def gammaln(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def gammaincc(x, y):
+    # ref gammaincc(x, y): regularized upper incomplete gamma Q(x, y)
+    return jax.scipy.special.gammaincc(x, y)
+
+
+def increment(x, *, value=1.0):
+    return x + jnp.asarray(value, x.dtype)
+
+
+def fill(x, *, value=0.0):
+    return jnp.full_like(x, value)
+
+
+def fill_diagonal(x, *, value=0.0, offset=0, wrap=False):
+    n, m = x.shape[-2], x.shape[-1]
+    i = jnp.arange(n)[:, None]
+    j = jnp.arange(m)[None, :]
+    mask = j - i == offset
+    if wrap and x.ndim == 2 and n > m:
+        # ref fill_diagonal(wrap=True): the diagonal restarts every
+        # (m+1) rows on tall matrices
+        mask = (j - i % (m + 1)) == offset
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+def clip_by_norm(x, *, max_norm):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return (x.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+def squared_l2_norm(x):
+    return jnp.sum(jnp.square(x.astype(jnp.float32))).reshape(())
+
+
+def renorm(x, *, p=2.0, axis=0, max_norm=1.0):
+    # per-slice p-norm clamp along `axis` (ref math.py renorm)
+    red = tuple(d for d in range(x.ndim) if d != axis % x.ndim)
+    xf = x.astype(jnp.float32)
+    norms = jnp.sum(jnp.abs(xf) ** p, axis=red, keepdims=True) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm,
+                      max_norm / jnp.maximum(norms, 1e-12), 1.0)
+    return (xf * scale).astype(x.dtype)
+
+
+def frobenius_norm(x, *, axis=None, keepdim=False):
+    if axis is None:
+        axis = (-2, -1)
+    return jnp.sqrt(jnp.sum(
+        jnp.square(x.astype(jnp.float32)), axis=tuple(axis),
+        keepdims=keepdim,
+    )).astype(x.dtype)
+
+
+def is_empty(x):
+    return jnp.asarray(x.size == 0)

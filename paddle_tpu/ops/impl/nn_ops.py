@@ -990,3 +990,113 @@ def max_pool2d_with_index(x, *, kernel_size, stride=None, padding=0,
     ix = ox * s[1] - pad[1][0] + kx
     mask = (iy * w + ix).astype(jnp.int32)
     return out, mask
+
+
+# ---- r5 breadth additions (ref python/paddle/nn/functional) --------------
+def huber_loss(input, label, *, delta=1.0, reduction="mean"):
+    err = input - label
+    a = jnp.abs(err)
+    loss = jnp.where(a <= delta, 0.5 * err * err,
+                     delta * (a - 0.5 * delta))
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def hinge_loss(logits, labels):
+    # ref hinge_loss: labels in {0,1}; elementwise max(0, 1 - (2y-1)*x)
+    sign = 2.0 * labels - 1.0
+    return jnp.maximum(0.0, 1.0 - sign * logits)
+
+
+def sequence_mask(lengths, *, maxlen=None, dtype="int64"):
+    import numpy as _np
+
+    if maxlen is None:
+        maxlen = int(_np.asarray(jax.device_get(lengths)).max())
+    pos = jnp.arange(maxlen)
+    mask = pos[None, :] < lengths.reshape(-1, 1)
+    return mask.reshape(tuple(lengths.shape) + (maxlen,)).astype(dtype)
+
+
+def max_unpool2d(x, indices, *, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    """Inverse of max_pool2d_with_index (ref functional/pooling.py
+    max_unpool2d): scatter pooled values back to their argmax slots."""
+    if stride is None:
+        stride = kernel_size
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    n, c, hp, wp = x.shape
+    if output_size is None:
+        ho = (hp - 1) * st[0] + ks[0] - 2 * padding
+        wo = (wp - 1) * st[1] + ks[1] - 2 * padding
+    else:
+        ho, wo = output_size[-2], output_size[-1]
+    flat_out = jnp.zeros((n, c, ho * wo), x.dtype)
+    idx = indices.reshape(n, c, hp * wp)
+    vals = x.reshape(n, c, hp * wp)
+    ni = jnp.arange(n)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    flat_out = flat_out.at[ni, ci, idx].set(vals)
+    return flat_out.reshape(n, c, ho, wo)
+
+
+def fold(x, *, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1):
+    """col2im — the inverse of unfold (ref functional/common.py fold):
+    scatter-add each column back to its image patch."""
+    def _pair(v):
+        if isinstance(v, int):
+            return (v, v)
+        t = tuple(v)
+        return (t[0], t[0]) if len(t) == 1 else t
+
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    n, ckk, l = x.shape
+    c = ckk // (kh * kw)
+    lh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    lw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    cols = x.reshape(n, c, kh, kw, lh, lw)
+    out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dh
+            wi = j * dw
+            out = out.at[:, :, hi:hi + lh * sh:sh,
+                         wi:wi + lw * sw:sw].add(cols[:, :, i, j])
+    if ph or pw:
+        out = out[:, :, ph:ph + oh, pw:pw + ow]
+    return out
+
+
+def spectral_norm(weight, *, dim=0, power_iters=1, eps=1e-12):
+    """Power-iteration spectral normalization (ref nn/functional
+    spectral_norm; the reference keeps u/v as persistent buffers — the
+    functional form re-runs the iteration from a fixed start, which is
+    deterministic under jit)."""
+    w = jnp.moveaxis(weight, dim, 0)
+    h = w.shape[0]
+    mat = w.reshape(h, -1).astype(jnp.float32)
+    u = jnp.ones((h,), jnp.float32) / (h ** 0.5)
+
+    def body(u, _):
+        v = mat.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+        u2 = mat @ v
+        u2 = u2 / jnp.maximum(jnp.linalg.norm(u2), eps)
+        return u2, v
+
+    u, vs = jax.lax.scan(body, u, None, length=max(1, power_iters))
+    v = vs[-1]
+    sigma = u @ mat @ v
+    return (w / sigma).reshape(w.shape).astype(weight.dtype) \
+        if dim == 0 else jnp.moveaxis(
+            (w / sigma).astype(weight.dtype), 0, dim)

@@ -92,3 +92,35 @@ def shuffle(x, *, key, axis=0):
 
 def standard_gamma(x, *, key):
     return jax.random.gamma(key, x).astype(x.dtype)
+
+
+# ---- r5 breadth additions ------------------------------------------------
+def binomial(count, prob, *, key):
+    """ref tensor/random.py binomial(count, prob): per-element draws."""
+    n = jnp.broadcast_to(count, jnp.broadcast_shapes(
+        jnp.shape(count), jnp.shape(prob)))
+    p = jnp.broadcast_to(prob, n.shape).astype(jnp.float32)
+    # sum of Bernoulli draws over the max count (static bound); counts
+    # vary per element via masking
+    import numpy as _np
+
+    nmax = int(_np.asarray(jax.device_get(n)).max()) if n.size else 0
+    draws = jax.random.uniform(key, (max(nmax, 1),) + tuple(n.shape))
+    mask = jnp.arange(max(nmax, 1))[(...,) + (None,) * n.ndim] < n
+    return jnp.sum(((draws < p) & mask).astype(jnp.int64), axis=0)
+
+
+def exponential(x, *, key, lam=1.0):
+    """ref Tensor.exponential_: fresh Exp(lam) samples shaped like x."""
+    u = jax.random.uniform(
+        key, x.shape,
+        dtype=x.dtype if x.dtype in (jnp.float32, jnp.float64)
+        else jnp.float32,
+        minval=1e-7, maxval=1.0,
+    )
+    return (-jnp.log(u) / lam).astype(x.dtype)
+
+
+def dirichlet(alpha, *, key):
+    """ref distribution Dirichlet sampling op."""
+    return jax.random.dirichlet(key, alpha.astype(jnp.float32))

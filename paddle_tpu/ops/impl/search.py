@@ -112,3 +112,67 @@ def bucketize(x, sorted_sequence, *, out_int32=False, right=False):
     side = "right" if right else "left"
     out = jnp.searchsorted(sorted_sequence, x, side=side)
     return out.astype(jnp.int32 if out_int32 else _IDX_DTYPE)
+
+
+# ---- r5 breadth additions ------------------------------------------------
+def gather_tree(ids, parents):
+    """Beam-search backtrace (ref tensor/search.py gather_tree):
+    ids/parents [max_time, batch, beam] -> full parent-chained paths."""
+    import jax
+    import jax.numpy as jnp
+
+    t, b, k = ids.shape
+    bi = jnp.arange(b)[:, None]
+
+    def body(beam_idx, inputs):
+        id_t, parent_t = inputs
+        out = id_t[bi, beam_idx]
+        return parent_t[bi, beam_idx], out
+
+    last = jnp.tile(jnp.arange(k)[None, :], (b, 1))
+    _, outs = jax.lax.scan(body, last, (ids, parents), reverse=True)
+    return outs
+
+
+def edit_distance(hyps, refs, hyp_lengths=None, ref_lengths=None, *,
+                  normalized=True):
+    """Levenshtein distance per batch row over padded int sequences
+    (ref nn/functional edit_distance; the CUDA kernel's DP table as a
+    lax.scan over rows)."""
+    import jax
+    import jax.numpy as jnp
+
+    b, m = hyps.shape
+    _, n = refs.shape
+    if hyp_lengths is None:
+        hyp_lengths = jnp.full((b,), m, jnp.int32)
+    if ref_lengths is None:
+        ref_lengths = jnp.full((b,), n, jnp.int32)
+
+    def one(hyp, ref, hl, rl):
+        row0 = jnp.arange(n + 1, dtype=jnp.int32)
+
+        def step(prev_row, i):
+            ins = prev_row[1:] + 1
+            sub = prev_row[:-1] + (hyp[i] != ref).astype(jnp.int32)
+
+            def scan_min(carry, xs):
+                ins_j, sub_j = xs
+                cur = jnp.minimum(jnp.minimum(ins_j, carry + 1), sub_j)
+                return cur, cur
+
+            _, rest = jax.lax.scan(scan_min, i + 1, (ins, sub))
+            row = jnp.concatenate([jnp.array([i + 1], jnp.int32), rest])
+            # rows past the true hypothesis length are padding: the DP
+            # state must stop evolving there (final == row at i=hl-1)
+            row = jnp.where(i < hl, row, prev_row)
+            return row, None
+
+        final, _ = jax.lax.scan(step, row0,
+                                jnp.arange(m, dtype=jnp.int32))
+        return final[rl].astype(jnp.float32)
+
+    d = jax.vmap(one)(hyps, refs, hyp_lengths, ref_lengths)
+    seq = jnp.maximum(ref_lengths.astype(jnp.float32), 1.0)
+    out = jnp.where(normalized, d / seq, d)
+    return out.reshape(b, 1), ref_lengths.reshape(b, 1)

@@ -9,8 +9,13 @@ from ..core.aux_tensors import (
     array_write,
     create_array,
 )
-from ..ops.api import *  # noqa: F401,F403
-from ..ops.api import __all__ as _ops_all
+from ..ops import api as _api
+
+# mirror the op surface by name rather than star-import: custom-op
+# registration (utils/cpp_extension) may append names to
+# ops.api.__all__ whose attributes live on other modules
+_ops_all = [n for n in _api.__all__ if hasattr(_api, n)]
+globals().update({n: getattr(_api, n) for n in _ops_all})
 
 __all__ = list(_ops_all) + [
     "TensorArray", "StringTensor", "create_array", "array_write",

@@ -1,5 +1,6 @@
 """paddle.vision analogue (ref: python/paddle/vision/__init__.py)."""
 from . import datasets, transforms
 from . import models
+from . import ops
 
-__all__ = ["datasets", "transforms", "models"]
+__all__ = ["datasets", "transforms", "models", "ops"]

@@ -1,0 +1,428 @@
+"""paddle.vision.ops — detection/vision operators.
+
+ref: python/paddle/vision/ops.py (nms:1934, roi_align:1705,
+roi_pool:1572, box_coder:584, deform_conv2d:766, ConvNormActivation).
+
+TPU-native notes: nms returns dynamically-many indices — inherently a
+host-side op (the reference's CUDA kernel also ends in a host copy of
+the kept count), so it runs eagerly on concrete tensors. roi_align /
+roi_pool are batched bilinear gathers — static shapes, fully jittable.
+read_file/decode_jpeg are declared but raise (zero-egress image, no
+codec); datasets feed arrays directly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+
+__all__ = [
+    "nms", "roi_align", "roi_pool", "box_coder", "deform_conv2d",
+    "DeformConv2D", "RoIAlign", "RoIPool", "ConvNormActivation",
+    "read_file", "decode_jpeg", "psroi_pool", "PSRoIPool",
+]
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Hard NMS over [N, 4] x1y1x2y2 boxes (ref ops.py:1934). Returns
+    kept indices sorted by descending score. Dynamic output size makes
+    this a host op by nature; inputs must be concrete (eager)."""
+    b = np.asarray(boxes.numpy() if isinstance(boxes, Tensor) else boxes)
+    n = b.shape[0]
+    s = (np.asarray(scores.numpy() if isinstance(scores, Tensor)
+                    else scores) if scores is not None
+         else np.arange(n, 0, -1, dtype=np.float32))
+    cats = (np.asarray(category_idxs.numpy()
+                       if isinstance(category_idxs, Tensor)
+                       else category_idxs)
+            if category_idxs is not None else np.zeros(n, np.int64))
+
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    keep = []
+    for c in np.unique(cats):
+        idx = np.where(cats == c)[0]
+        order = idx[np.argsort(-s[idx])]
+        while order.size:
+            i = order[0]
+            keep.append(i)
+            if order.size == 1:
+                break
+            rest = order[1:]
+            xx1 = np.maximum(b[i, 0], b[rest, 0])
+            yy1 = np.maximum(b[i, 1], b[rest, 1])
+            xx2 = np.minimum(b[i, 2], b[rest, 2])
+            yy2 = np.minimum(b[i, 3], b[rest, 3])
+            inter = np.clip(xx2 - xx1, 0, None) * np.clip(
+                yy2 - yy1, 0, None)
+            iou = inter / (areas[i] + areas[rest] - inter + 1e-9)
+            order = rest[iou <= iou_threshold]
+    keep = np.array(sorted(keep, key=lambda i: -s[i]), np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep, stop_gradient=True)
+
+
+def _bilinear_gather(feat, ys, xs):
+    """feat [C,H,W]; ys/xs arbitrary same-shape float grids -> [C,*]."""
+    import jax.numpy as jnp
+
+    h, w = feat.shape[-2], feat.shape[-1]
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    wy = jnp.clip(ys - y0, 0.0, 1.0)
+    wx = jnp.clip(xs - x0, 0.0, 1.0)
+    v00 = feat[:, y0, x0]
+    v01 = feat[:, y0, x1]
+    v10 = feat[:, y1, x0]
+    v11 = feat[:, y1, x1]
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign (ref ops.py:1705): average of bilinear samples per bin.
+    x [N,C,H,W]; boxes [R,4]; boxes_num [N] rois per image. Gradients
+    flow to x and boxes (the op records on the tape via dispatch)."""
+    import jax
+
+    from ..core import dispatch
+
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    bn = np.asarray(boxes_num.numpy() if isinstance(boxes_num, Tensor)
+                    else boxes_num)
+    img_idx = np.repeat(np.arange(len(bn)), bn)
+    off = 0.5 if aligned else 0.0
+    # adaptive sampling (ref sampling_ratio=-1): ceil(roi_size/out_size)
+    # per ROI, computed from host box values so shapes stay static
+    bx_host = np.asarray(
+        jax.device_get(boxes._data if isinstance(boxes, Tensor)
+                       else boxes))
+    if sampling_ratio > 0:
+        srs = [int(sampling_ratio)] * bx_host.shape[0]
+    else:
+        srs = [
+            max(1, int(np.ceil(
+                max(bx_host[r, 3] - bx_host[r, 1], 1e-4)
+                * spatial_scale / ph)))
+            for r in range(bx_host.shape[0])
+        ]
+
+    def impl(xd, bxd):
+        import jax.numpy as jnp
+
+        outs = []
+        for r in range(bxd.shape[0]):
+            feat = xd[int(img_idx[r])]
+            sr = srs[r]
+            x1, y1, x2, y2 = [bxd[r, k] * spatial_scale - off
+                              for k in range(4)]
+            bh = jnp.maximum(y2 - y1, 1e-4) / ph
+            bw = jnp.maximum(x2 - x1, 1e-4) / pw
+            iy = (jnp.arange(ph)[:, None, None, None]
+                  * bh + y1
+                  + (jnp.arange(sr)[None, None, :, None] + 0.5)
+                  * bh / sr)
+            ix = (jnp.arange(pw)[None, :, None, None]
+                  * bw + x1
+                  + (jnp.arange(sr)[None, None, None, :] + 0.5)
+                  * bw / sr)
+            iy = jnp.broadcast_to(iy, (ph, pw, sr, sr))
+            ix = jnp.broadcast_to(ix, (ph, pw, sr, sr))
+            vals = _bilinear_gather(feat, iy.reshape(-1),
+                                    ix.reshape(-1))
+            vals = vals.reshape(feat.shape[0], ph, pw,
+                                sr * sr).mean(-1)
+            outs.append(vals)
+        return jnp.stack(outs) if outs else jnp.zeros(
+            (0, xd.shape[1], ph, pw), xd.dtype)
+
+    xt = x if isinstance(x, Tensor) else Tensor(x, stop_gradient=True)
+    bt = boxes if isinstance(boxes, Tensor) else Tensor(
+        boxes, stop_gradient=True)
+    return dispatch.call("roi_align", impl, (xt, bt), {})
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Max-pool RoI variant (ref ops.py:1572): adaptive max over each
+    bin's integer sub-window."""
+    import jax.numpy as jnp
+
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    bx = np.asarray(boxes.numpy() if isinstance(boxes, Tensor)
+                    else boxes)
+    bn = np.asarray(boxes_num.numpy() if isinstance(boxes_num, Tensor)
+                    else boxes_num)
+    img_idx = np.repeat(np.arange(len(bn)), bn)
+    h, w = xd.shape[-2], xd.shape[-1]
+    outs = []
+    for r in range(bx.shape[0]):
+        feat = xd[int(img_idx[r])]
+        x1 = int(round(bx[r, 0] * spatial_scale))
+        y1 = int(round(bx[r, 1] * spatial_scale))
+        x2 = max(int(round(bx[r, 2] * spatial_scale)), x1 + 1)
+        y2 = max(int(round(bx[r, 3] * spatial_scale)), y1 + 1)
+        x1, y1 = min(x1, w - 1), min(y1, h - 1)
+        x2, y2 = min(x2, w), min(y2, h)
+        bins = []
+        for i in range(ph):
+            ys = y1 + (y2 - y1) * i // ph
+            ye = max(y1 + (y2 - y1) * (i + 1) // ph, ys + 1)
+            for j in range(pw):
+                xs = x1 + (x2 - x1) * j // pw
+                xe = max(x1 + (x2 - x1) * (j + 1) // pw, xs + 1)
+                bins.append(feat[:, ys:ye, xs:xe].max(axis=(-2, -1)))
+        outs.append(jnp.stack(bins, -1).reshape(
+            feat.shape[0], ph, pw))
+    out = jnp.stack(outs) if outs else jnp.zeros(
+        (0, xd.shape[1], ph, pw), xd.dtype)
+    return Tensor(out, stop_gradient=True)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Position-sensitive RoI pool (ref ops.py:1441): channel group
+    (i,j) feeds bin (i,j); average within the bin."""
+    import jax.numpy as jnp
+
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    c_out = xd.shape[1] // (ph * pw)
+    pooled = roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                       sampling_ratio=2, aligned=False)
+    # out[r, c, i, j] = pooled[r, (i*pw + j)*c_out + c, i, j]: keep the
+    # advanced indices ADJACENT (a split placement would move the
+    # broadcast dims to the front)
+    pd = pooled._data.reshape(-1, ph * pw, c_out, ph, pw)
+    pdm = jnp.moveaxis(pd, 2, -1)             # [R, ph*pw, ph, pw, c]
+    ii = jnp.arange(ph)[:, None]
+    jj = jnp.arange(pw)[None, :]
+    bin_idx = ii * pw + jj                    # [ph, pw]
+    out = pdm[:, bin_idx, ii, jj]             # [R, ph, pw, c]
+    return Tensor(jnp.transpose(out, (0, 3, 1, 2)), stop_gradient=True)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0):
+    """Encode/decode boxes against priors (ref ops.py:584)."""
+    import jax.numpy as jnp
+
+    pb = prior_box._data if isinstance(prior_box, Tensor) \
+        else jnp.asarray(prior_box)
+    tb = target_box._data if isinstance(target_box, Tensor) \
+        else jnp.asarray(target_box)
+    var = (prior_box_var._data if isinstance(prior_box_var, Tensor)
+           else jnp.asarray(prior_box_var)) \
+        if prior_box_var is not None else jnp.ones_like(pb)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[..., 2] - pb[..., 0] + norm
+    ph_ = pb[..., 3] - pb[..., 1] + norm
+    pcx = pb[..., 0] + pw * 0.5
+    pcy = pb[..., 1] + ph_ * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[..., 2] - tb[..., 0] + norm
+        th = tb[..., 3] - tb[..., 1] + norm
+        tcx = tb[..., 0] + tw * 0.5
+        tcy = tb[..., 1] + th * 0.5
+        out = jnp.stack([
+            (tcx - pcx) / pw / var[..., 0],
+            (tcy - pcy) / ph_ / var[..., 1],
+            jnp.log(tw / pw) / var[..., 2],
+            jnp.log(th / ph_) / var[..., 3],
+        ], -1)
+    else:  # decode_center_size
+        ocx = var[..., 0] * tb[..., 0] * pw + pcx
+        ocy = var[..., 1] * tb[..., 1] * ph_ + pcy
+        ow = jnp.exp(var[..., 2] * tb[..., 2]) * pw
+        oh = jnp.exp(var[..., 3] * tb[..., 3]) * ph_
+        out = jnp.stack([
+            ocx - ow * 0.5, ocy - oh * 0.5,
+            ocx + ow * 0.5 - norm, ocy + oh * 0.5 - norm,
+        ], -1)
+    return Tensor(out, stop_gradient=True)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Deformable conv v1/v2 (ref ops.py:766): bilinear-sample the
+    input at offset positions per kernel tap, then a 1x1 contraction."""
+    import jax.numpy as jnp
+
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    wd = weight._data if isinstance(weight, Tensor) \
+        else jnp.asarray(weight)
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    sh, sw = _pair(stride)
+    ph_, pw_ = _pair(padding)
+    dh, dw = _pair(dilation)
+    n, cin, h, w = xd.shape
+    cout, _, kh, kw = wd.shape
+    ho = (h + 2 * ph_ - dh * (kh - 1) - 1) // sh + 1
+    wo = (w + 2 * pw_ - dw * (kw - 1) - 1) // sw + 1
+    from ..core import dispatch
+
+    md_t = mask if mask is None or isinstance(mask, Tensor) \
+        else Tensor(mask, stop_gradient=True)
+
+    def impl(xd2, od2, wd2, bd2=None, md2=None):
+        xp = jnp.pad(xd2, ((0, 0), (0, 0), (ph_, ph_), (pw_, pw_)))
+        base_y = jnp.arange(ho)[:, None] * sh
+        base_x = jnp.arange(wo)[None, :] * sw
+        cols = []
+        for ki in range(kh):
+            for kj in range(kw):
+                t = ki * kw + kj
+                oy = od2[:, 2 * t]
+                ox = od2[:, 2 * t + 1]
+                ys = base_y[None] + ki * dh + oy
+                xs = base_x[None] + kj * dw + ox
+                sampled = jnp.stack([
+                    _bilinear_gather(
+                        xp[b], ys[b].reshape(-1), xs[b].reshape(-1)
+                    ).reshape(cin, ho, wo)
+                    for b in range(n)
+                ])
+                if md2 is not None:
+                    sampled = sampled * md2[:, t][:, None]
+                cols.append(sampled)
+        col = jnp.stack(cols, 2)  # [n, cin, kh*kw, ho, wo]
+        out = jnp.einsum("nckhw,ock->nohw",
+                         col, wd2.reshape(cout, cin, kh * kw))
+        if bd2 is not None:
+            out = out + bd2[None, :, None, None]
+        return out
+
+    def _t(v):
+        if v is None or isinstance(v, Tensor):
+            return v
+        return Tensor(v, stop_gradient=True)
+
+    # None placeholders pass through dispatch untouched, so impl sees
+    # its five positional slots regardless of which optionals exist
+    return dispatch.call(
+        "deform_conv2d", impl,
+        (_t(x), _t(offset), _t(weight), _t(bias), md_t), {},
+    )
+
+
+class DeformConv2D(nn.Layer):
+    """ref ops.py:973 — learnable weight/bias over deform_conv2d."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+        from ..nn.parameter import ParamAttr
+
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._attrs = dict(stride=stride, padding=padding,
+                           dilation=dilation,
+                           deformable_groups=deformable_groups,
+                           groups=groups)
+        self.weight = self.create_parameter(
+            shape=[out_channels, in_channels // groups, *ks],
+            attr=ParamAttr._to_attr(weight_attr) if weight_attr
+            else ParamAttr(initializer=I.XavierUniform()),
+        )
+        self.bias = None if bias_attr is False else self.create_parameter(
+            shape=[out_channels],
+            attr=ParamAttr._to_attr(bias_attr) if bias_attr
+            else ParamAttr(initializer=I.Constant(0.0)),
+        )
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._attrs)
+
+
+class RoIAlign(nn.Layer):
+    """ref ops.py:1826."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale)
+
+
+class RoIPool(nn.Layer):
+    """ref ops.py:1657."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class PSRoIPool(nn.Layer):
+    """ref ops.py:1523."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+class ConvNormActivation(nn.Sequential):
+    """ref ops.py:1877 — Conv2D + norm + activation block."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3,
+                 stride=1, padding=None, groups=1,
+                 norm_layer=nn.BatchNorm2D, activation_layer=nn.ReLU,
+                 dilation=1, bias=None):
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if bias is None:
+            bias = norm_layer is None
+        layers = [nn.Conv2D(
+            in_channels, out_channels, kernel_size, stride=stride,
+            padding=padding, dilation=dilation, groups=groups,
+            bias_attr=None if bias else False,
+        )]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)
+
+
+def read_file(filename, name=None):
+    raise NotImplementedError(
+        "read_file needs an image codec; this environment is zero-egress "
+        "with no libjpeg binding — feed decoded arrays via paddle.vision "
+        "datasets/transforms instead"
+    )
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    raise NotImplementedError(
+        "decode_jpeg needs libjpeg; feed decoded arrays via "
+        "paddle.vision datasets/transforms instead"
+    )

@@ -1,0 +1,31 @@
+"""Custom-device plugin surface (ref: phi/backends/device_ext.h C-ABI,
+mapped onto the PJRT C API — see paddle_tpu/device/plugin.py)."""
+import os
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.device import plugin
+
+
+def test_register_missing_library_raises():
+    with pytest.raises(FileNotFoundError, match="plugin not found"):
+        plugin.register_custom_device("nodev", "/no/such/libdev.so")
+
+
+def test_unregistered_device_not_available():
+    assert not plugin.is_custom_device_available("never_registered")
+    assert "never_registered" not in plugin.list_custom_devices()
+
+
+def test_env_spec_parsing_is_resilient(monkeypatch, capsys):
+    monkeypatch.setenv(
+        "PADDLE_PJRT_PLUGINS", "bad_entry,foo=/does/not/exist.so"
+    )
+    plugin._load_env_plugins()  # must not raise
+    err = capsys.readouterr().err
+    assert "failed to register" in err
+
+
+def test_namespace_export():
+    assert paddle.device.plugin is plugin

@@ -555,6 +555,61 @@ HINTS = {
     "cummax": dict(out=0, grad=False),
     "cummin": dict(out=0, grad=False),
     "prod": dict(range=(0.5, 1.5)),
+    # ---- r5 breadth additions ---------------------------------------------
+    "gammaincc": dict(range=(0.5, 2.0)),
+    # (increment is the in-place counter op in ops/api.py, not yaml)
+    "fill": dict(grad=False),
+    "fill_diagonal": dict(inputs=dict(x=_f((3, 3))),
+                          attrs=dict(value=0.5)),
+    "clip_by_norm": dict(attrs=dict(max_norm=10.0)),
+    "renorm": dict(attrs=dict(max_norm=0.1)),
+    "frobenius_norm": dict(inputs=dict(x=_f((3, 4)))),
+    "is_empty": dict(grad=False),
+    "reverse": dict(attrs=dict(axis=[0])),
+    "as_strided": dict(attrs=dict(shape=[2, 2], stride=[1, 1])),
+    "channel_shuffle": dict(inputs=dict(x=_f((1, 4, 2, 2))),
+                            attrs=dict(groups=2)),
+    "temporal_shift": dict(inputs=dict(x=_f((4, 4, 2, 2))),
+                           attrs=dict(seg_num=2)),
+    "huber_loss": dict(inputs=dict(input=_f((3, 4)),
+                                   label=_f((3, 4), seed=1))),
+    "hinge_loss": dict(
+        inputs=dict(logits=_f((2, 3), -1, 1),
+                    labels=(_f((2, 3), 0, 1, seed=1) > 0.5)
+                    .astype("float32")),
+        grad=False),
+    "sequence_mask": dict(inputs=dict(lengths=_i((3,), 4) + 1),
+                          attrs=dict(maxlen=5), grad=False),
+    "max_unpool2d": dict(
+        inputs=dict(x=_f((1, 1, 2, 2)),
+                    indices=np.array([[[[0, 3], [8, 15]]]], "int64")),
+        attrs=dict(kernel_size=2), grad="x"),
+    "fold": dict(inputs=dict(x=_f((1, 4, 4))),
+                 attrs=dict(output_sizes=[3, 3], kernel_sizes=2),
+                 grad="x"),
+    "spectral_norm": dict(inputs=dict(weight=_f((3, 4))), grad=False),
+    "frame": dict(inputs=dict(x=_f((8,))),
+                  attrs=dict(frame_length=4, hop_length=2), grad="x"),
+    "overlap_add": dict(inputs=dict(x=_f((4, 3))),
+                        attrs=dict(hop_length=2), grad="x"),
+    "gather_tree": dict(
+        inputs=dict(ids=_i((3, 2, 2), 4), parents=_i((3, 2, 2), 2)),
+        grad=False),
+    "edit_distance": dict(
+        inputs=dict(hyps=_i((2, 4), 5), refs=_i((2, 5), 5, seed=1)),
+        grad=False, out=0),
+    "lu_unpack": dict(
+        inputs=dict(x=_f((3, 3)),
+                    y=np.array([1, 2, 3], "int32")),
+        grad=False, out=1),
+    "p_norm": dict(),
+    "binomial": dict(
+        inputs=dict(count=_i((2, 3), 5),
+                    prob=_f((2, 3), 0.2, 0.8, seed=1)),
+        grad=False),
+    "exponential": dict(grad=False),
+    "dirichlet": dict(inputs=dict(alpha=_f((4,), 0.5, 2.0)),
+                      grad=False),
     # ---- search (integral outputs) ----------------------------------------
     "argmax": dict(grad=False),
     "argmin": dict(grad=False),

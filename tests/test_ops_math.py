@@ -135,3 +135,43 @@ def test_pow_remainder():
     b = np.array([2, 3, 3, 4], dtype=np.int32)
     got = paddle.remainder(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
     np.testing.assert_array_equal(got, a % b)
+
+
+class TestR5BreadthEdgeCases:
+    """Review r5 regressions: padded edit_distance, batched lu_unpack,
+    vectorized overlap_add equivalence."""
+
+    def test_edit_distance_honors_hyp_lengths(self):
+        import paddle_tpu.tensor as T
+
+        d, _ = T.edit_distance(
+            paddle.to_tensor(np.array([[1, 2, 0, 0]], "int64")),
+            paddle.to_tensor(np.array([[1, 2]], "int64")),
+            hyp_lengths=paddle.to_tensor(np.array([2], "int64")),
+            ref_lengths=paddle.to_tensor(np.array([2], "int64")),
+            normalized=False,
+        )
+        assert float(d.numpy()[0, 0]) == 0.0
+
+    def test_lu_unpack_batched(self):
+        import paddle_tpu.tensor as T
+
+        rng = np.random.RandomState(0)
+        a = rng.randn(2, 3, 3).astype("float32") + 3 * np.eye(
+            3, dtype="float32")
+        lu, piv = T.lu(paddle.to_tensor(a))
+        P, L, U = T.lu_unpack(lu, piv)
+        rec = np.einsum("bij,bjk,bkl->bil",
+                        P.numpy(), L.numpy(), U.numpy())
+        np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-4)
+
+    def test_overlap_add_matches_loop(self):
+        import paddle_tpu.tensor as T
+
+        x = np.random.RandomState(0).rand(4, 3).astype("float32")
+        hop = 2
+        want = np.zeros(4 + hop * 2, "float32")
+        for f in range(3):
+            want[f * hop:f * hop + 4] += x[:, f]
+        got = T.overlap_add(paddle.to_tensor(x), hop_length=hop)
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-6)

@@ -1,0 +1,133 @@
+"""paddle.vision.ops: nms / roi_align / roi_pool / box_coder /
+deform_conv2d (ref: python/paddle/vision/ops.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def test_nms_suppresses_overlaps():
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10],
+        [1, 1, 11, 11],     # IoU ~0.68 with box 0 -> suppressed
+        [20, 20, 30, 30],   # disjoint -> kept
+    ], "float32"))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], "float32"))
+    keep = V.nms(boxes, iou_threshold=0.5, scores=scores)
+    assert keep.numpy().tolist() == [0, 2]
+
+
+def test_nms_categories_and_topk():
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10], [1, 1, 11, 11], [2, 2, 12, 12],
+    ], "float32"))
+    scores = paddle.to_tensor(np.array([0.5, 0.9, 0.8], "float32"))
+    cats = paddle.to_tensor(np.array([0, 1, 0], "int64"))
+    keep = V.nms(boxes, iou_threshold=0.5, scores=scores,
+                 category_idxs=cats, categories=[0, 1], top_k=2)
+    # per-category NMS keeps the best of each; sorted by score
+    assert keep.numpy().tolist() == [1, 2]
+
+
+def test_roi_align_shapes_and_values():
+    # constant feature map: every aligned bin must equal the constant
+    x = paddle.to_tensor(np.full((1, 3, 8, 8), 2.5, "float32"))
+    boxes = paddle.to_tensor(np.array([[1.0, 1.0, 6.0, 6.0]], "float32"))
+    out = V.roi_align(x, boxes, paddle.to_tensor(np.array([1], "int32")),
+                      output_size=2)
+    assert tuple(out.shape) == (1, 3, 2, 2)
+    np.testing.assert_allclose(out.numpy(), 2.5, rtol=1e-5)
+
+
+def test_roi_pool_max_semantics():
+    feat = np.zeros((1, 1, 8, 8), "float32")
+    feat[0, 0, 2, 2] = 7.0
+    x = paddle.to_tensor(feat)
+    boxes = paddle.to_tensor(np.array([[0.0, 0.0, 8.0, 8.0]], "float32"))
+    out = V.roi_pool(x, boxes, paddle.to_tensor(np.array([1], "int32")),
+                     output_size=1)
+    assert float(out.numpy().max()) == 7.0
+
+
+def test_box_coder_roundtrip():
+    prior = paddle.to_tensor(np.array([[10.0, 10.0, 30.0, 30.0]],
+                                      "float32"))
+    var = paddle.to_tensor(np.ones((1, 4), "float32"))
+    target = paddle.to_tensor(np.array([[12.0, 8.0, 33.0, 28.0]],
+                                       "float32"))
+    enc = V.box_coder(prior, var, target, code_type="encode_center_size")
+    dec = V.box_coder(prior, var, enc, code_type="decode_center_size")
+    np.testing.assert_allclose(dec.numpy(), target.numpy(), atol=1e-3)
+
+
+def test_deform_conv2d_zero_offset_matches_conv():
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(1, 2, 6, 6).astype("float32"))
+    w = paddle.to_tensor(rng.randn(3, 2, 3, 3).astype("float32"))
+    offset = paddle.to_tensor(np.zeros((1, 18, 4, 4), "float32"))
+    out = V.deform_conv2d(x, offset, w)
+    ref = F.conv2d(x, w)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_conv_norm_activation_block():
+    blk = V.ConvNormActivation(3, 8, kernel_size=3, stride=2)
+    out = blk(paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 8, 8).astype("float32")))
+    assert tuple(out.shape) == (2, 8, 4, 4)
+
+
+def test_roi_layers():
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .rand(1, 4, 8, 8).astype("float32"))
+    boxes = paddle.to_tensor(np.array([[0.0, 0.0, 7.0, 7.0]], "float32"))
+    num = paddle.to_tensor(np.array([1], "int32"))
+    assert tuple(V.RoIAlign(2)(x, boxes, num).shape) == (1, 4, 2, 2)
+    assert tuple(V.RoIPool(2)(x, boxes, num).shape) == (1, 4, 2, 2)
+
+
+def test_read_file_raises_with_guidance():
+    with pytest.raises(NotImplementedError, match="zero-egress|codec"):
+        V.read_file("x.jpg")
+
+
+def test_roi_align_and_deform_conv_gradients_flow():
+    """Review r5: these ops must record on the tape (frozen-weight bug)."""
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(1, 2, 6, 6).astype("float32"))
+    x.stop_gradient = False
+    boxes = paddle.to_tensor(np.array([[1.0, 1.0, 5.0, 5.0]], "float32"))
+    out = V.roi_align(x, boxes, paddle.to_tensor(np.array([1], "int32")),
+                      output_size=2)
+    out.sum().backward()
+    assert x.grad is not None and float(x.grad.abs().sum().numpy()) > 0
+
+    w = paddle.to_tensor(rng.randn(3, 2, 3, 3).astype("float32"))
+    w.stop_gradient = False
+    x2 = paddle.to_tensor(rng.randn(1, 2, 6, 6).astype("float32"))
+    x2.stop_gradient = False
+    offset = paddle.to_tensor(np.zeros((1, 18, 4, 4), "float32"))
+    out = V.deform_conv2d(x2, offset, w)
+    out.sum().backward()
+    assert w.grad is not None and x2.grad is not None
+    assert np.isfinite(w.grad.numpy()).all()
+
+
+def test_psroi_pool_shape_and_position_sensitivity():
+    ph = pw = 2
+    c_out = 3
+    x = np.zeros((1, ph * pw * c_out, 8, 8), "float32")
+    # channel group for bin (0,0) carries a distinctive constant
+    x[:, 0:c_out] = 5.0
+    out = V.psroi_pool(
+        paddle.to_tensor(x),
+        paddle.to_tensor(np.array([[0.0, 0.0, 8.0, 8.0]], "float32")),
+        paddle.to_tensor(np.array([1], "int32")), (ph, pw))
+    assert tuple(out.shape) == (1, c_out, ph, pw)
+    np.testing.assert_allclose(out.numpy()[0, :, 0, 0], 5.0, rtol=1e-5)
+    np.testing.assert_allclose(out.numpy()[0, :, 1, 1], 0.0, atol=1e-5)
