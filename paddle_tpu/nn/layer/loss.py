@@ -5,6 +5,7 @@ from ... import ops as F
 from .layers import Layer
 
 __all__ = [
+    "CTCLoss",
     "BCELoss", "BCEWithLogitsLoss", "CrossEntropyLoss", "CosineEmbeddingLoss",
     "HingeEmbeddingLoss", "KLDivLoss", "L1Loss", "MarginRankingLoss",
     "MSELoss", "NLLLoss", "SmoothL1Loss", "TripletMarginLoss",
@@ -177,4 +178,23 @@ class TripletMarginLoss(Layer):
     def forward(self, input, positive, negative):
         return F.triplet_margin_loss(
             input, positive, negative, self.margin, self.p, self.reduction
+        )
+
+
+class CTCLoss(Layer):
+    """ref: nn/layer/loss.py CTCLoss — wraps functional.ctc_loss
+    (warpctc analogue; see ops/impl/nn_ops.py ctc_loss)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        _check_reduction(reduction)
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(
+            log_probs, labels, input_lengths, label_lengths,
+            blank=self.blank, reduction=self.reduction,
+            norm_by_times=norm_by_times,
         )

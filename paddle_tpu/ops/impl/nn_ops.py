@@ -998,11 +998,7 @@ def huber_loss(input, label, *, delta=1.0, reduction="mean"):
     a = jnp.abs(err)
     loss = jnp.where(a <= delta, 0.5 * err * err,
                      delta * (a - 0.5 * delta))
-    if reduction == "mean":
-        return loss.mean()
-    if reduction == "sum":
-        return loss.sum()
-    return loss
+    return _reduce_loss(loss, reduction)
 
 
 def hinge_loss(logits, labels):
@@ -1100,3 +1096,78 @@ def spectral_norm(weight, *, dim=0, power_iters=1, eps=1e-12):
     return (w / sigma).reshape(w.shape).astype(weight.dtype) \
         if dim == 0 else jnp.moveaxis(
             (w / sigma).astype(weight.dtype), 0, dim)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, *,
+             blank=0, reduction="mean", norm_by_times=False):
+    """CTC loss (ref nn/functional/loss.py ctc_loss over the warpctc op).
+
+    TPU-native form: the alpha (forward-variable) recursion in log space
+    as one lax.scan over time — jax.vjp supplies the gradient, replacing
+    warpctc's hand-written backward. log_probs [T, B, C] (time-major,
+    the reference's layout), labels [B, L] padded, lengths int."""
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    neg_inf = -1e30
+
+    lp = jax.nn.log_softmax(log_probs.astype(jnp.float32), axis=-1)
+    labels = labels.astype(jnp.int32)
+    # extended sequence: blank, l1, blank, l2, ..., blank  [B, S]
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    # can alpha skip the previous blank? only between DIFFERENT labels
+    prev_lab = jnp.concatenate(
+        [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1
+    )
+    can_skip = (ext != blank) & (ext != prev_lab)
+
+    # state mask: states beyond 2*label_len stay -inf
+    smask = jnp.arange(S)[None, :] < (
+        2 * label_lengths.astype(jnp.int32) + 1
+    )[:, None]
+
+    emit0 = jnp.take_along_axis(lp[0], ext, axis=1)  # [B, S]
+    alpha0 = jnp.where(
+        (jnp.arange(S)[None, :] < 2) & smask, emit0, neg_inf
+    )
+
+    def step(alpha, lp_t):
+        stay = alpha
+        prev1 = jnp.concatenate(
+            [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1
+        )
+        prev2 = jnp.concatenate(
+            [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1
+        )
+        prev2 = jnp.where(can_skip, prev2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        new = jnp.where(smask, merged + emit, neg_inf)
+        return new, new
+
+    _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T,B,S]
+
+    # read alpha at each sequence's LAST valid frame, summed over the
+    # final two states (last label, trailing blank)
+    t_idx = jnp.clip(input_lengths.astype(jnp.int32) - 1, 0, T - 1)
+    alpha_last = alphas[t_idx, jnp.arange(B)]  # [B, S]
+    s_last = 2 * label_lengths.astype(jnp.int32)  # trailing blank state
+    a_blank = jnp.take_along_axis(
+        alpha_last, s_last[:, None], axis=1
+    )[:, 0]
+    a_label = jnp.take_along_axis(
+        alpha_last, jnp.maximum(s_last - 1, 0)[:, None], axis=1
+    )[:, 0]
+    a_label = jnp.where(label_lengths > 0, a_label, neg_inf)
+    nll = -jnp.logaddexp(a_blank, a_label)
+    if norm_by_times:
+        nll = nll / jnp.maximum(input_lengths.astype(jnp.float32), 1.0)
+    if reduction == "mean":
+        # the reference (and torch) divide by label length under mean
+        return (nll / jnp.maximum(
+            label_lengths.astype(jnp.float32), 1.0)).mean()
+    if reduction == "sum":
+        return nll.sum()
+    return nll

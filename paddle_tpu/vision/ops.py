@@ -87,7 +87,14 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True):
     """RoIAlign (ref ops.py:1705): average of bilinear samples per bin.
     x [N,C,H,W]; boxes [R,4]; boxes_num [N] rois per image. Gradients
-    flow to x and boxes (the op records on the tape via dispatch)."""
+    flow to x and boxes (the op records on the tape via dispatch).
+
+    TPU-native shape discipline: ONE vmapped gather over all ROIs (no
+    per-ROI program growth). The adaptive sampling grid
+    (sampling_ratio=-1 -> ceil(roi_size/out_size) per axis, per the
+    reference) must be static under jit, so the grid is the per-axis
+    MAX over the call's ROIs — small ROIs get at-least-as-dense
+    sampling, identical bin averages in the constant-feature limit."""
     import jax
 
     from ..core import dispatch
@@ -99,49 +106,47 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                     else boxes_num)
     img_idx = np.repeat(np.arange(len(bn)), bn)
     off = 0.5 if aligned else 0.0
-    # adaptive sampling (ref sampling_ratio=-1): ceil(roi_size/out_size)
-    # per ROI, computed from host box values so shapes stay static
     bx_host = np.asarray(
         jax.device_get(boxes._data if isinstance(boxes, Tensor)
                        else boxes))
     if sampling_ratio > 0:
-        srs = [int(sampling_ratio)] * bx_host.shape[0]
+        sr_y = sr_x = int(sampling_ratio)
+    elif bx_host.shape[0]:
+        sr_y = max(1, int(np.ceil(
+            (bx_host[:, 3] - bx_host[:, 1]).max()
+            * spatial_scale / ph)))
+        sr_x = max(1, int(np.ceil(
+            (bx_host[:, 2] - bx_host[:, 0]).max()
+            * spatial_scale / pw)))
     else:
-        srs = [
-            max(1, int(np.ceil(
-                max(bx_host[r, 3] - bx_host[r, 1], 1e-4)
-                * spatial_scale / ph)))
-            for r in range(bx_host.shape[0])
-        ]
+        sr_y = sr_x = 1
 
     def impl(xd, bxd):
         import jax.numpy as jnp
 
-        outs = []
-        for r in range(bxd.shape[0]):
-            feat = xd[int(img_idx[r])]
-            sr = srs[r]
-            x1, y1, x2, y2 = [bxd[r, k] * spatial_scale - off
+        if bxd.shape[0] == 0:
+            return jnp.zeros((0, xd.shape[1], ph, pw), xd.dtype)
+        feats = xd[jnp.asarray(img_idx)]          # [R, C, H, W]
+
+        def one(feat, box):
+            x1, y1, x2, y2 = [box[k] * spatial_scale - off
                               for k in range(4)]
             bh = jnp.maximum(y2 - y1, 1e-4) / ph
             bw = jnp.maximum(x2 - x1, 1e-4) / pw
-            iy = (jnp.arange(ph)[:, None, None, None]
-                  * bh + y1
-                  + (jnp.arange(sr)[None, None, :, None] + 0.5)
-                  * bh / sr)
-            ix = (jnp.arange(pw)[None, :, None, None]
-                  * bw + x1
-                  + (jnp.arange(sr)[None, None, None, :] + 0.5)
-                  * bw / sr)
-            iy = jnp.broadcast_to(iy, (ph, pw, sr, sr))
-            ix = jnp.broadcast_to(ix, (ph, pw, sr, sr))
+            iy = (jnp.arange(ph)[:, None, None, None] * bh + y1
+                  + (jnp.arange(sr_y)[None, None, :, None] + 0.5)
+                  * bh / sr_y)
+            ix = (jnp.arange(pw)[None, :, None, None] * bw + x1
+                  + (jnp.arange(sr_x)[None, None, None, :] + 0.5)
+                  * bw / sr_x)
+            iy = jnp.broadcast_to(iy, (ph, pw, sr_y, sr_x))
+            ix = jnp.broadcast_to(ix, (ph, pw, sr_y, sr_x))
             vals = _bilinear_gather(feat, iy.reshape(-1),
                                     ix.reshape(-1))
-            vals = vals.reshape(feat.shape[0], ph, pw,
-                                sr * sr).mean(-1)
-            outs.append(vals)
-        return jnp.stack(outs) if outs else jnp.zeros(
-            (0, xd.shape[1], ph, pw), xd.dtype)
+            return vals.reshape(feat.shape[0], ph, pw,
+                                sr_y * sr_x).mean(-1)
+
+        return jax.vmap(one)(feats, bxd)
 
     xt = x if isinstance(x, Tensor) else Tensor(x, stop_gradient=True)
     bt = boxes if isinstance(boxes, Tensor) else Tensor(
@@ -151,65 +156,80 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
     """Max-pool RoI variant (ref ops.py:1572): adaptive max over each
-    bin's integer sub-window."""
-    import jax.numpy as jnp
+    bin's integer sub-window. Bin boundaries come from host box values
+    (static slices); the max itself records on the tape via dispatch so
+    gradients reach x."""
+    from ..core import dispatch
 
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     ph, pw = output_size
-    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
     bx = np.asarray(boxes.numpy() if isinstance(boxes, Tensor)
                     else boxes)
     bn = np.asarray(boxes_num.numpy() if isinstance(boxes_num, Tensor)
                     else boxes_num)
     img_idx = np.repeat(np.arange(len(bn)), bn)
-    h, w = xd.shape[-2], xd.shape[-1]
-    outs = []
-    for r in range(bx.shape[0]):
-        feat = xd[int(img_idx[r])]
-        x1 = int(round(bx[r, 0] * spatial_scale))
-        y1 = int(round(bx[r, 1] * spatial_scale))
-        x2 = max(int(round(bx[r, 2] * spatial_scale)), x1 + 1)
-        y2 = max(int(round(bx[r, 3] * spatial_scale)), y1 + 1)
-        x1, y1 = min(x1, w - 1), min(y1, h - 1)
-        x2, y2 = min(x2, w), min(y2, h)
-        bins = []
-        for i in range(ph):
-            ys = y1 + (y2 - y1) * i // ph
-            ye = max(y1 + (y2 - y1) * (i + 1) // ph, ys + 1)
-            for j in range(pw):
-                xs = x1 + (x2 - x1) * j // pw
-                xe = max(x1 + (x2 - x1) * (j + 1) // pw, xs + 1)
-                bins.append(feat[:, ys:ye, xs:xe].max(axis=(-2, -1)))
-        outs.append(jnp.stack(bins, -1).reshape(
-            feat.shape[0], ph, pw))
-    out = jnp.stack(outs) if outs else jnp.zeros(
-        (0, xd.shape[1], ph, pw), xd.dtype)
-    return Tensor(out, stop_gradient=True)
+
+    def impl(xd):
+        import jax.numpy as jnp
+
+        h, w = xd.shape[-2], xd.shape[-1]
+        outs = []
+        for r in range(bx.shape[0]):
+            feat = xd[int(img_idx[r])]
+            x1 = int(round(bx[r, 0] * spatial_scale))
+            y1 = int(round(bx[r, 1] * spatial_scale))
+            x2 = max(int(round(bx[r, 2] * spatial_scale)), x1 + 1)
+            y2 = max(int(round(bx[r, 3] * spatial_scale)), y1 + 1)
+            x1, y1 = min(x1, w - 1), min(y1, h - 1)
+            x2, y2 = min(x2, w), min(y2, h)
+            bins = []
+            for i in range(ph):
+                ys = y1 + (y2 - y1) * i // ph
+                ye = max(y1 + (y2 - y1) * (i + 1) // ph, ys + 1)
+                for j in range(pw):
+                    xs = x1 + (x2 - x1) * j // pw
+                    xe = max(x1 + (x2 - x1) * (j + 1) // pw, xs + 1)
+                    bins.append(
+                        feat[:, ys:ye, xs:xe].max(axis=(-2, -1)))
+            outs.append(jnp.stack(bins, -1).reshape(
+                feat.shape[0], ph, pw))
+        return jnp.stack(outs) if outs else jnp.zeros(
+            (0, xd.shape[1], ph, pw), xd.dtype)
+
+    xt = x if isinstance(x, Tensor) else Tensor(x, stop_gradient=True)
+    return dispatch.call("roi_pool", impl, (xt,), {})
 
 
 def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
     """Position-sensitive RoI pool (ref ops.py:1441): channel group
-    (i,j) feeds bin (i,j); average within the bin."""
-    import jax.numpy as jnp
+    (i,j) feeds bin (i,j); average within the bin. Built on the
+    differentiable roi_align, with the position-sensitive selection as
+    a taped op so gradients reach x."""
+    from ..core import dispatch
 
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     ph, pw = output_size
-    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    c_out = xd.shape[1] // (ph * pw)
+    cin = (x._data if isinstance(x, Tensor) else x).shape[1]
+    c_out = cin // (ph * pw)
     pooled = roi_align(x, boxes, boxes_num, output_size, spatial_scale,
                        sampling_ratio=2, aligned=False)
-    # out[r, c, i, j] = pooled[r, (i*pw + j)*c_out + c, i, j]: keep the
-    # advanced indices ADJACENT (a split placement would move the
-    # broadcast dims to the front)
-    pd = pooled._data.reshape(-1, ph * pw, c_out, ph, pw)
-    pdm = jnp.moveaxis(pd, 2, -1)             # [R, ph*pw, ph, pw, c]
-    ii = jnp.arange(ph)[:, None]
-    jj = jnp.arange(pw)[None, :]
-    bin_idx = ii * pw + jj                    # [ph, pw]
-    out = pdm[:, bin_idx, ii, jj]             # [R, ph, pw, c]
-    return Tensor(jnp.transpose(out, (0, 3, 1, 2)), stop_gradient=True)
+
+    def impl(pd_in):
+        import jax.numpy as jnp
+
+        # out[r, c, i, j] = pd[r, (i*pw + j)*c_out + c, i, j] — keep
+        # the advanced indices ADJACENT (split placement would move the
+        # broadcast dims to the front)
+        pd = pd_in.reshape(-1, ph * pw, c_out, ph, pw)
+        pdm = jnp.moveaxis(pd, 2, -1)         # [R, ph*pw, ph, pw, c]
+        ii = jnp.arange(ph)[:, None]
+        jj = jnp.arange(pw)[None, :]
+        out = pdm[:, ii * pw + jj, ii, jj]    # [R, ph, pw, c]
+        return jnp.transpose(out, (0, 3, 1, 2))
+
+    return dispatch.call("psroi_pool_select", impl, (pooled,), {})
 
 
 def box_coder(prior_box, prior_box_var, target_box,
@@ -278,27 +298,43 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     md_t = mask if mask is None or isinstance(mask, Tensor) \
         else Tensor(mask, stop_gradient=True)
 
+    if groups != 1:
+        raise NotImplementedError(
+            "deform_conv2d groups>1 (channel-grouped weights) is not "
+            "supported; deformable_groups IS supported"
+        )
+    dg = int(deformable_groups)
+    cg = cin // dg  # input channels per deformable group
+
     def impl(xd2, od2, wd2, bd2=None, md2=None):
         xp = jnp.pad(xd2, ((0, 0), (0, 0), (ph_, ph_), (pw_, pw_)))
         base_y = jnp.arange(ho)[:, None] * sh
         base_x = jnp.arange(wo)[None, :] * sw
         cols = []
+        # offsets layout (ref deform_conv2d): [n, dg*2*kh*kw, ho, wo] —
+        # each deformable group g displaces ITS channel slice
         for ki in range(kh):
             for kj in range(kw):
                 t = ki * kw + kj
-                oy = od2[:, 2 * t]
-                ox = od2[:, 2 * t + 1]
-                ys = base_y[None] + ki * dh + oy
-                xs = base_x[None] + kj * dw + ox
-                sampled = jnp.stack([
-                    _bilinear_gather(
-                        xp[b], ys[b].reshape(-1), xs[b].reshape(-1)
-                    ).reshape(cin, ho, wo)
-                    for b in range(n)
-                ])
-                if md2 is not None:
-                    sampled = sampled * md2[:, t][:, None]
-                cols.append(sampled)
+                group_samples = []
+                for g in range(dg):
+                    base = g * 2 * kh * kw
+                    oy = od2[:, base + 2 * t]
+                    ox = od2[:, base + 2 * t + 1]
+                    ys = base_y[None] + ki * dh + oy
+                    xs = base_x[None] + kj * dw + ox
+                    sampled = jnp.stack([
+                        _bilinear_gather(
+                            xp[b, g * cg:(g + 1) * cg],
+                            ys[b].reshape(-1), xs[b].reshape(-1)
+                        ).reshape(cg, ho, wo)
+                        for b in range(n)
+                    ])
+                    if md2 is not None:
+                        sampled = sampled * md2[
+                            :, g * kh * kw + t][:, None]
+                    group_samples.append(sampled)
+                cols.append(jnp.concatenate(group_samples, axis=1))
         col = jnp.stack(cols, 2)  # [n, cin, kh*kw, ho, wo]
         out = jnp.einsum("nckhw,ock->nohw",
                          col, wd2.reshape(cout, cin, kh * kw))

@@ -175,3 +175,66 @@ class TestR5BreadthEdgeCases:
             want[f * hop:f * hop + 4] += x[:, f]
         got = T.overlap_add(paddle.to_tensor(x), hop_length=hop)
         np.testing.assert_allclose(got.numpy(), want, rtol=1e-6)
+
+
+class TestCTCLoss:
+    """ctc_loss vs the torch oracle (the repo's cross-validation pattern,
+    SURVEY §4): forward values and input gradients must match."""
+
+    def _case(self, T=12, B=3, C=6, L=5, seed=0):
+        rng = np.random.RandomState(seed)
+        logits = rng.randn(T, B, C).astype("float32")
+        labels = rng.randint(1, C, (B, L)).astype("int32")
+        in_lens = np.array([T, T - 2, T - 4], "int64")[:B]
+        lab_lens = np.array([L, L - 1, L - 2], "int64")[:B]
+        return logits, labels, in_lens, lab_lens
+
+    def _torch_ref(self, logits, labels, in_lens, lab_lens, reduction):
+        import torch
+
+        t_logits = torch.tensor(logits, requires_grad=True)
+        lp = torch.log_softmax(t_logits, dim=-1)
+        loss = torch.nn.functional.ctc_loss(
+            lp, torch.tensor(labels.astype("int64")),
+            torch.tensor(in_lens), torch.tensor(lab_lens),
+            blank=0, reduction=reduction, zero_infinity=False,
+        )
+        loss.backward(torch.ones_like(loss))
+        return loss.detach().numpy(), t_logits.grad.numpy()
+
+    @pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+    def test_matches_torch(self, reduction):
+        import paddle_tpu.nn.functional as F
+
+        logits, labels, in_lens, lab_lens = self._case()
+        want, want_grad = self._torch_ref(
+            logits, labels, in_lens, lab_lens, reduction
+        )
+        lt = paddle.to_tensor(logits)
+        lt.stop_gradient = False
+        got = F.ctc_loss(
+            lt, paddle.to_tensor(labels),
+            paddle.to_tensor(in_lens), paddle.to_tensor(lab_lens),
+            blank=0, reduction=reduction,
+        )
+        np.testing.assert_allclose(got.numpy(), want, rtol=1e-4,
+                                   atol=1e-4)
+        if reduction != "none":
+            got.backward()
+        else:
+            got.sum().backward()
+            want_grad = self._torch_ref(
+                logits, labels, in_lens, lab_lens, "sum"
+            )[1]
+        np.testing.assert_allclose(lt.grad.numpy(), want_grad,
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_layer_api(self):
+        import paddle_tpu.nn as nn
+
+        logits, labels, in_lens, lab_lens = self._case()
+        loss = nn.CTCLoss(blank=0, reduction="mean")(
+            paddle.to_tensor(logits), paddle.to_tensor(labels),
+            paddle.to_tensor(in_lens), paddle.to_tensor(lab_lens),
+        )
+        assert np.isfinite(float(loss.numpy()))

@@ -131,3 +131,68 @@ def test_psroi_pool_shape_and_position_sensitivity():
     assert tuple(out.shape) == (1, c_out, ph, pw)
     np.testing.assert_allclose(out.numpy()[0, :, 0, 0], 5.0, rtol=1e-5)
     np.testing.assert_allclose(out.numpy()[0, :, 1, 1], 0.0, atol=1e-5)
+
+
+def test_roi_pool_and_psroi_gradients_flow():
+    """Review r5 round 2: roi_pool/psroi_pool must keep the tape."""
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .rand(1, 4, 8, 8).astype("float32"))
+    x.stop_gradient = False
+    boxes = paddle.to_tensor(np.array([[0.0, 0.0, 8.0, 8.0]], "float32"))
+    num = paddle.to_tensor(np.array([1], "int32"))
+    V.roi_pool(x, boxes, num, 2).sum().backward()
+    assert x.grad is not None and float(x.grad.abs().sum().numpy()) > 0
+
+    x2 = paddle.to_tensor(np.random.RandomState(1)
+                          .rand(1, 12, 8, 8).astype("float32"))
+    x2.stop_gradient = False
+    V.psroi_pool(x2, boxes, num, 2).sum().backward()
+    assert x2.grad is not None
+    assert float(x2.grad.abs().sum().numpy()) > 0
+
+
+def test_deform_conv2d_deformable_groups():
+    """dg=2: group 1's offsets must displace ONLY its channel slice."""
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(1, 4, 6, 6).astype("float32"))
+    w = paddle.to_tensor(rng.randn(2, 4, 3, 3).astype("float32"))
+    off0 = np.zeros((1, 2 * 2 * 9, 4, 4), "float32")
+    base = V.deform_conv2d(x, paddle.to_tensor(off0), w,
+                           deformable_groups=2)
+    # zero offsets == plain conv regardless of dg
+    import paddle_tpu.nn.functional as F
+
+    np.testing.assert_allclose(base.numpy(), F.conv2d(x, w).numpy(),
+                               rtol=1e-4, atol=1e-4)
+    # shifting ONLY group 1's offsets changes the output...
+    off1 = off0.copy()
+    off1[:, 2 * 9:] = 0.7
+    moved = V.deform_conv2d(x, paddle.to_tensor(off1), w,
+                            deformable_groups=2)
+    assert not np.allclose(moved.numpy(), base.numpy())
+    # ...and differs from shifting group 0's (groups are independent)
+    off2 = off0.copy()
+    off2[:, :2 * 9] = 0.7
+    moved0 = V.deform_conv2d(x, paddle.to_tensor(off2), w,
+                             deformable_groups=2)
+    assert not np.allclose(moved0.numpy(), moved.numpy())
+
+
+def test_deform_conv2d_groups_raises():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(1, 4, 6, 6).astype("float32"))
+    w = paddle.to_tensor(rng.randn(4, 2, 3, 3).astype("float32"))
+    off = paddle.to_tensor(np.zeros((1, 18, 4, 4), "float32"))
+    with pytest.raises(NotImplementedError, match="groups"):
+        V.deform_conv2d(x, off, w, groups=2)
+
+
+def test_roi_align_wide_roi_per_axis_sampling():
+    """Per-axis adaptive grid: a wide flat ROI on a constant map must
+    still average to the constant (x-axis grid dense enough)."""
+    x = paddle.to_tensor(np.full((1, 1, 6, 64), 1.75, "float32"))
+    boxes = paddle.to_tensor(np.array([[0.0, 1.0, 60.0, 5.0]],
+                                      "float32"))
+    out = V.roi_align(x, boxes, paddle.to_tensor(np.array([1], "int32")),
+                      output_size=2)
+    np.testing.assert_allclose(out.numpy(), 1.75, rtol=1e-5)
