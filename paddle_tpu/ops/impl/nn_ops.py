@@ -1017,28 +1017,42 @@ def sequence_mask(lengths, *, maxlen=None, dtype="int64"):
     return mask.reshape(tuple(lengths.shape) + (maxlen,)).astype(dtype)
 
 
+def _max_unpool_nd(x, indices, rank, kernel_size, stride, padding,
+                   output_size):
+    """Shared scatter body for max_unpool2d/3d: place each pooled value
+    at its flat argmax slot in the restored spatial volume."""
+    if stride is None:
+        stride = kernel_size
+    ks = (kernel_size,) * rank if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = (stride,) * rank if isinstance(stride, int) else tuple(stride)
+    n, c = x.shape[:2]
+    pooled = x.shape[2:]
+    if output_size is None:
+        out_sp = tuple(
+            (pooled[d] - 1) * st[d] + ks[d] - 2 * padding
+            for d in range(rank)
+        )
+    else:
+        out_sp = tuple(output_size[-rank:])
+    numel = 1
+    for v in out_sp:
+        numel *= v
+    flat_out = jnp.zeros((n, c, numel), x.dtype)
+    idx = indices.reshape(n, c, -1)
+    vals = x.reshape(n, c, -1)
+    ni = jnp.arange(n)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    flat_out = flat_out.at[ni, ci, idx].set(vals)
+    return flat_out.reshape((n, c) + out_sp)
+
+
 def max_unpool2d(x, indices, *, kernel_size, stride=None, padding=0,
                  output_size=None):
     """Inverse of max_pool2d_with_index (ref functional/pooling.py
     max_unpool2d): scatter pooled values back to their argmax slots."""
-    if stride is None:
-        stride = kernel_size
-    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
-        else tuple(kernel_size)
-    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
-    n, c, hp, wp = x.shape
-    if output_size is None:
-        ho = (hp - 1) * st[0] + ks[0] - 2 * padding
-        wo = (wp - 1) * st[1] + ks[1] - 2 * padding
-    else:
-        ho, wo = output_size[-2], output_size[-1]
-    flat_out = jnp.zeros((n, c, ho * wo), x.dtype)
-    idx = indices.reshape(n, c, hp * wp)
-    vals = x.reshape(n, c, hp * wp)
-    ni = jnp.arange(n)[:, None, None]
-    ci = jnp.arange(c)[None, :, None]
-    flat_out = flat_out.at[ni, ci, idx].set(vals)
-    return flat_out.reshape(n, c, ho, wo)
+    return _max_unpool_nd(x, indices, 2, kernel_size, stride, padding,
+                          output_size)
 
 
 def fold(x, *, output_sizes, kernel_sizes, strides=1, paddings=0,
@@ -1171,3 +1185,67 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, *,
     if reduction == "sum":
         return nll.sum()
     return nll
+
+
+def lp_pool2d(x, *, norm_type=2.0, kernel_size=2, stride=None,
+              padding=0, ceil_mode=False, data_format="NCHW"):
+    """Power-average pooling (ref functional/pooling.py lp_pool2d):
+    (sum |x|^p over window)^(1/p), built on the existing avg pool."""
+    if stride is None:
+        stride = kernel_size
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    p = float(norm_type)
+    powed = jnp.abs(x) ** p
+    avg = avg_pool2d(powed, kernel_size=kernel_size, stride=stride,
+                     padding=padding, ceil_mode=ceil_mode,
+                     data_format=data_format)
+    n_win = ks[0] * ks[1]
+    return (avg * n_win) ** (1.0 / p)
+
+
+def fractional_max_pool2d(x, *, output_size, kernel_size=None,
+                          random_u=None):
+    """Fractional max pooling (ref functional/pooling.py
+    fractional_max_pool2d): pseudo-random pooling regions whose sizes
+    average H/out_h. Deterministic region boundaries from `random_u`
+    (the reference's test-mode contract; None -> u=0.5)."""
+    if kernel_size is not None:
+        raise NotImplementedError(
+            "fractional_max_pool2d overlapping mode (kernel_size) is "
+            "not supported; omit kernel_size for disjoint regions"
+        )
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    t = tuple(output_size)  # IntArray coercion may yield a 1-elt list
+    oh, ow = (t[0], t[0]) if len(t) == 1 else t
+    n, c, h, w = x.shape
+    u = 0.5 if random_u is None else float(random_u)
+
+    def bounds(inp, out):
+        # ref formula: ceil((i + u) * inp / out) - ceil(u * inp / out)
+        import math
+
+        alpha = inp / out
+        return [int(math.ceil((i + u) * alpha)
+                    - math.ceil(u * alpha)) for i in range(out + 1)]
+
+    ys = bounds(h, oh)
+    xs = bounds(w, ow)
+    rows = []
+    for i in range(oh):
+        cols = []
+        y0, y1 = ys[i], max(ys[i + 1], ys[i] + 1)
+        for j in range(ow):
+            x0, x1 = xs[j], max(xs[j + 1], xs[j] + 1)
+            cols.append(x[:, :, y0:y1, x0:x1].max(axis=(-2, -1)))
+        rows.append(jnp.stack(cols, -1))
+    return jnp.stack(rows, -2)
+
+
+def max_unpool3d(x, indices, *, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    """3-D inverse of max pooling (ref functional/pooling.py
+    max_unpool3d) — the 3-D instance of the shared scatter body."""
+    return _max_unpool_nd(x, indices, 3, kernel_size, stride, padding,
+                          output_size)

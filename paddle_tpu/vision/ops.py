@@ -109,15 +109,20 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     bx_host = np.asarray(
         jax.device_get(boxes._data if isinstance(boxes, Tensor)
                        else boxes))
+    # cap the shared adaptive grid: one near-image-size ROI would
+    # otherwise force its dense grid onto EVERY ROI in the vmapped
+    # gather (512 ROIs x 7x7x115x115 samples = OOM); >=8 samples/bin
+    # per axis is within float32 noise of the exact bin integral
+    _SR_CAP = 8
     if sampling_ratio > 0:
         sr_y = sr_x = int(sampling_ratio)
     elif bx_host.shape[0]:
-        sr_y = max(1, int(np.ceil(
+        sr_y = min(_SR_CAP, max(1, int(np.ceil(
             (bx_host[:, 3] - bx_host[:, 1]).max()
-            * spatial_scale / ph)))
-        sr_x = max(1, int(np.ceil(
+            * spatial_scale / ph))))
+        sr_x = min(_SR_CAP, max(1, int(np.ceil(
             (bx_host[:, 2] - bx_host[:, 0]).max()
-            * spatial_scale / pw)))
+            * spatial_scale / pw))))
     else:
         sr_y = sr_x = 1
 
@@ -304,6 +309,10 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
             "supported; deformable_groups IS supported"
         )
     dg = int(deformable_groups)
+    if cin % dg != 0:
+        raise ValueError(
+            f"deformable_groups={dg} must divide in_channels={cin}"
+        )
     cg = cin // dg  # input channels per deformable group
 
     def impl(xd2, od2, wd2, bd2=None, md2=None):

@@ -612,6 +612,16 @@ HINTS = {
     "exponential": dict(grad=False),
     "dirichlet": dict(inputs=dict(alpha=_f((4,), 0.5, 2.0)),
                       grad=False),
+    "lp_pool2d": dict(inputs=dict(x=_f((1, 2, 6, 6))),
+                      attrs=dict(kernel_size=2)),
+    "fractional_max_pool2d": dict(inputs=dict(x=_f((1, 2, 8, 8))),
+                                  attrs=dict(output_size=3),
+                                  grad=False),  # max ties under u=0.5
+    "max_unpool3d": dict(
+        inputs=dict(x=_f((1, 1, 2, 2, 2)),
+                    indices=np.arange(8).reshape(
+                        1, 1, 2, 2, 2).astype("int64") * 7),
+        attrs=dict(kernel_size=2), grad="x"),
     # ---- search (integral outputs) ----------------------------------------
     "argmax": dict(grad=False),
     "argmin": dict(grad=False),
