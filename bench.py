@@ -339,9 +339,76 @@ def bench_dit(paddle, on_tpu):
     return batch / dt
 
 
+def bench_serving(paddle, on_tpu):
+    """Continuous-batching mixed workload (serving row): many concurrent
+    requests with heterogeneous prompt/output lengths through ONE
+    fixed-shape compiled decode step + bucketed prefill. The [serving]
+    metric is end-to-end generated tokens/s including scheduling,
+    admission, and KV-block management — the multi-tenant counterpart of
+    the single-stream [decode] row."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import Engine, EngineConfig, SamplingParams
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=12, num_attention_heads=16,
+        max_position_embeddings=2048,
+    ) if on_tpu else LlamaConfig.tiny()
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    n_req, slots, mml = (32, 8, 512) if on_tpu else (8, 4, 64)
+    ecfg = EngineConfig(
+        max_batch_slots=slots, max_model_len=mml,
+        page_size=16 if on_tpu else 8,
+    )
+    rng = np.random.RandomState(0)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, rng.randint(8, mml // 4)).tolist()
+        for _ in range(n_req)
+    ]
+    params = [
+        SamplingParams(max_new_tokens=int(rng.randint(mml // 8, mml // 2)))
+        for _ in range(n_req)
+    ]
+
+    eng = Engine(model, ecfg)   # reused: the timed run hits warm programs
+
+    def run():
+        outs = eng.generate(prompts, params)
+        return outs, sum(len(o.token_ids) for o in outs)
+
+    t0 = time.perf_counter()
+    run()
+    log(f"[serving] compile+first run: {time.perf_counter()-t0:.1f}s "
+        f"(prefill compiles={eng.metrics.prefill_compiles}, "
+        f"decode compiles={eng.metrics.decode_compiles})")
+    t0 = time.perf_counter()
+    outs, n_tokens = run()
+    dt = time.perf_counter() - t0
+    tps = n_tokens / dt
+    ttft = float(np.mean([o.time_to_first_token for o in outs]))
+    bm = eng.block_manager
+    log(f"[serving] {n_req} reqs x {slots} slots mml={mml}: "
+        f"{n_tokens} tokens in {dt:.2f}s -> {tps:,.0f} tokens/s "
+        f"(ttft={ttft*1e3:.0f}ms hw={bm.high_water} "
+        f"preempt={eng.metrics.preemptions} "
+        f"compiles={eng.metrics.prefill_compiles}"
+        f"+{eng.metrics.decode_compiles})")
+    # stdout: picked up by main() into the BENCH json line
+    print(json.dumps({
+        "metric": "serving_mixed_tokens_per_s",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+    }))
+    return tps
+
+
 ROWS = {
     "llama": lambda p, tpu, peak: bench_llama(p, tpu, peak),
     "decode": lambda p, tpu, peak: bench_decode(p, tpu),
+    "serving": lambda p, tpu, peak: bench_serving(p, tpu),
     "moe": lambda p, tpu, peak: bench_moe(p, tpu, peak),
     "resnet": lambda p, tpu, peak: bench_resnet(p, tpu),
     "dit": lambda p, tpu, peak: bench_dit(p, tpu),
@@ -411,6 +478,7 @@ def _run_row(name):
 
 def main():
     mfu = _run_row("llama")
+    extra_metrics = {}
 
     if os.environ.get("BENCH_ONLY", "") != "llama":
         # each extra row runs in its OWN process: chip buffers from one
@@ -426,9 +494,18 @@ def main():
                 capture_output=True, text=True, timeout=600, env=env,
             )
             sys.stderr.write(r.stderr)
+            # rows may report a metric of their own as a stdout JSON line
+            # (the serving row does); fold it into the BENCH json
+            for line in r.stdout.splitlines():
+                try:
+                    d = json.loads(line)
+                    if isinstance(d, dict) and "metric" in d:
+                        extra_metrics[d["metric"]] = d["value"]
+                except ValueError:
+                    pass
             return r.returncode
 
-        for name in ("decode", "moe", "resnet", "dit"):
+        for name in ("decode", "serving", "moe", "resnet", "dit"):
             try:
                 if name == "moe":
                     # shrink ladder: retry in fresh subprocesses until a
@@ -460,6 +537,7 @@ def main():
         "value": round(mfu * 100, 2),
         "unit": "%",
         "vs_baseline": round(mfu / 0.45, 4),
+        **extra_metrics,
     }))
 
 

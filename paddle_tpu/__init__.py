@@ -114,6 +114,7 @@ from . import nn  # noqa: E402
 from . import optimizer  # noqa: E402
 from . import quantization  # noqa: E402
 from . import regularizer  # noqa: E402
+from . import serving  # noqa: E402
 from . import signal  # noqa: E402
 from . import sparse  # noqa: E402
 from . import static  # noqa: E402

@@ -107,6 +107,18 @@ def save_state_dict(state_dict, path, process_group=None,
             meta["tensors"][key] = {"python": True}
             arrays[key] = value
 
+    if async_save:
+        # snapshot BEFORE the background writer starts: Tensor values were
+        # already copied out via np.asarray, but raw ndarrays and python
+        # containers were held by reference, racing user mutation against
+        # the writer thread
+        import copy as _copy
+
+        arrays = {
+            k: (v.copy() if isinstance(v, np.ndarray) else _copy.deepcopy(v))
+            for k, v in arrays.items()
+        }
+
     pyvals = {
         k: v for k, v in arrays.items() if not isinstance(v, np.ndarray)
     }

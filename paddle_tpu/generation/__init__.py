@@ -21,7 +21,7 @@ import collections
 from .. import ops as F
 from ..core.tensor import Tensor
 
-__all__ = ["KVCache", "GenerationConfig", "GenerationMixin"]
+__all__ = ["KVCache", "GenerationConfig", "GenerationMixin", "warp_logits"]
 
 # fixed-size decode cache for one attention layer:
 #   k, v: [batch, max_length, num_kv_heads, head_dim]
@@ -45,33 +45,53 @@ class GenerationConfig:
         self.pad_token_id = pad_token_id
 
 
+def warp_logits(logits, temperature=1.0, top_k=0, top_p=1.0):
+    """Logit warps on a raw [rows, vocab] array, mirroring the reference's
+    top_p_sampling op semantics (ref: python/paddle/tensor/search.py
+    top_p_sampling). Parameters may be python scalars or per-row [rows]
+    arrays — the same implementation serves the single-stream ``generate``
+    loop (scalar knobs) and serving's continuous batch (per-slot knobs,
+    serving/sampler.py). Tokens tied with the k-th largest logit are kept
+    (value-threshold semantics); the per-row argmax always survives."""
+    import jax
+    import jax.numpy as jnp
+
+    x = logits.astype(jnp.float32)
+    rows, vocab = x.shape
+    if (not hasattr(temperature, "shape") and not hasattr(top_k, "shape")
+            and not hasattr(top_p, "shape") and temperature == 1.0
+            and top_k <= 0 and top_p >= 1.0):
+        # scalar knobs are static at trace time: skip the vocab-wide
+        # sort/softmax/cumsum when every warp is a no-op (the default
+        # do_sample path of the single-stream decode loop)
+        return x
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (rows,))
+    k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (rows,))
+    p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (rows,))
+    x = x / t[:, None]
+    sx = -jnp.sort(-x, axis=-1)  # descending
+    # top-k: value threshold at the k-th largest (k <= 0 disables)
+    k_eff = jnp.where(k > 0, jnp.minimum(k, vocab), vocab)
+    kth = jnp.take_along_axis(sx, (k_eff - 1)[:, None], axis=-1)
+    x = jnp.where(x >= kth, x, -1e30)
+    sx = jnp.where(sx >= kth, sx, -1e30)
+    # top-p: keep tokens whose cumulative mass (exclusive) is < top_p;
+    # always keep the argmax. Threshold value: smallest logit still kept.
+    probs = jax.nn.softmax(sx, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < p[:, None]
+    masked = jnp.where(keep_sorted, sx, 1e30)
+    thresh = jnp.min(masked, axis=-1, keepdims=True)
+    return jnp.where(x >= thresh, x, -1e30)
+
+
 def _process_logits(logits, temperature, top_k, top_p):
-    """Logit warps, mirroring the reference's top_p_sampling op semantics
-    (ref: python/paddle/tensor/search.py top_p_sampling). Pure tensor ops
-    so the whole warp stages into the decode program."""
-    if temperature != 1.0:
-        logits = logits / temperature
-    if top_k and top_k > 0:
-        kth = F.topk(logits, top_k, axis=-1)[0][:, -1:]
-        logits = F.where(
-            logits >= kth, logits, F.full_like(logits, -1e30)
-        )
-    if top_p < 1.0:
-        sorted_logits = F.sort(logits, axis=-1, descending=True)
-        probs = F.softmax(sorted_logits, axis=-1)
-        cum = F.cumsum(probs, axis=-1)
-        # keep tokens whose cumulative mass (exclusive) is < top_p; always
-        # keep the argmax
-        keep_sorted = (cum - probs) < top_p
-        # threshold value: smallest logit still kept
-        masked = F.where(
-            keep_sorted, sorted_logits, F.full_like(sorted_logits, 1e30)
-        )
-        thresh = F.min(masked, axis=-1, keepdim=True)
-        logits = F.where(
-            logits >= thresh, logits, F.full_like(logits, -1e30)
-        )
-    return logits
+    """Tensor-level wrapper over ``warp_logits`` (pure array math, so the
+    whole warp stages into the decode program)."""
+    return Tensor(
+        warp_logits(logits._data, temperature, top_k, top_p),
+        stop_gradient=True,
+    )
 
 
 def _sample(logits, do_sample, temperature, top_k, top_p):
@@ -83,7 +103,7 @@ def _sample(logits, do_sample, temperature, top_k, top_p):
     logits = _process_logits(logits, temperature, top_k, top_p)
     u = F.uniform(logits.shape, min=1e-9, max=1.0, dtype="float32")
     gumbel = -F.log(-F.log(u))
-    return F.argmax(logits.astype("float32") + gumbel, axis=-1)
+    return F.argmax(logits + gumbel, axis=-1)
 
 
 class GenerationMixin:

@@ -15,18 +15,23 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+__all__ = [
+    "Config", "Predictor", "create_predictor", "LLMPredictor",
+    "create_llm_predictor",
+]
 
 
 class Config:
     """ref inference Config: model path + tuning knobs. TPU-native: the
     device/ir-optim/TensorRT knobs of the reference collapse into XLA;
-    kept fields are the model location and bucketing policy."""
+    kept fields are the model location, bucketing policy, and the
+    continuous-batching serving knobs."""
 
     def __init__(self, model_path=None, params_path=None):
         self.model_path = model_path
         self.params_path = params_path
         self._buckets = None
+        self._serving = None
 
     # API-parity knobs (accepted, their work is XLA's)
     def enable_memory_optim(self, *a, **k):
@@ -45,6 +50,16 @@ class Config:
         """TPU-native knob: pad variable dims to buckets so serving
         compiles a bounded program set (jit/bucketing.py)."""
         self._buckets = dict(dim_to_sizes)
+
+    def enable_continuous_batching(self, **engine_kwargs):
+        """Turn on the multi-tenant serving path (serving.Engine): the
+        kwargs are EngineConfig fields (max_batch_slots, max_model_len,
+        page_size, num_blocks, prefill_buckets, max_waiting, seed).
+        Consumed by ``create_llm_predictor``/``LLMPredictor``."""
+        self._serving = dict(engine_kwargs)
+
+    def continuous_batching_enabled(self):
+        return self._serving is not None
 
 
 class _IOHandle:
@@ -149,3 +164,57 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class LLMPredictor:
+    """Predictor-style facade over ``serving.Engine`` — the multi-request
+    analogue of ``Predictor``: where Predictor runs one saved program per
+    call, LLMPredictor owns an admission queue + continuous-batching
+    scheduler and serves many generation requests through one fixed-shape
+    compiled step (ref motivation: analysis_predictor.cc is single-stream;
+    this is the serving front the reference delegates to FastDeploy).
+
+        cfg = Config()
+        cfg.enable_continuous_batching(max_batch_slots=8, max_model_len=256)
+        p = create_llm_predictor(cfg, model)       # a causal LM
+        outs = p.generate([[1, 2, 3], [4, 5]], max_new_tokens=16)
+    """
+
+    def __init__(self, model, config: Config | None = None, **engine_kwargs):
+        from ..serving import Engine, EngineConfig
+
+        kwargs = dict(
+            (config._serving or {}) if config is not None else {}
+        )
+        kwargs.update(engine_kwargs)
+        self.engine = Engine(model, EngineConfig(**kwargs))
+
+    def generate(self, prompts, sampling_params=None, **param_kwargs):
+        """prompts: list of token-id lists. Returns one RequestOutput per
+        prompt (submission order). ``param_kwargs`` build a shared
+        SamplingParams when none is passed explicitly; combining both
+        forms is ambiguous and raises."""
+        from ..serving import SamplingParams
+
+        if param_kwargs:
+            if sampling_params is not None:
+                raise ValueError(
+                    "pass either sampling_params or SamplingParams "
+                    f"keyword fields, not both (got {sorted(param_kwargs)})"
+                )
+            sampling_params = SamplingParams(**param_kwargs)
+        return self.engine.generate(prompts, sampling_params)
+
+    def metrics(self):
+        return self.engine.metrics.snapshot()
+
+
+def create_llm_predictor(config: Config, model) -> LLMPredictor:
+    """Build the serving facade from a Config with
+    ``enable_continuous_batching()`` set and a live causal-LM model."""
+    if not config.continuous_batching_enabled():
+        raise ValueError(
+            "call config.enable_continuous_batching(...) first (or use "
+            "create_predictor for the single-stream path)"
+        )
+    return LLMPredictor(model, config)

@@ -27,10 +27,13 @@ from __future__ import annotations
 
 from ..core.tensor import Tensor
 
-__all__ = ["bucketize", "BucketedFunction"]
+__all__ = ["bucketize", "BucketedFunction", "next_bucket"]
 
 
-def _next_bucket(size, buckets):
+def next_bucket(size, buckets):
+    """Smallest bucket holding ``size`` (buckets ascending). Public: the
+    serving engine buckets prefill lengths through the same policy so the
+    compiled-program set stays bounded."""
     for b in buckets:
         if size <= b:
             return b
@@ -38,6 +41,9 @@ def _next_bucket(size, buckets):
         f"size {size} exceeds the largest bucket {buckets[-1]}; add a "
         "bigger bucket"
     )
+
+
+_next_bucket = next_bucket  # pre-r6 internal name
 
 
 class BucketedFunction:

@@ -1,0 +1,20 @@
+"""jax version compatibility for the Pallas TPU kernels.
+
+jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams``;
+resolve whichever this jax exposes once, and fail loudly at import time
+(not at first kernel call) if neither exists.
+"""
+from __future__ import annotations
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+if CompilerParams is None:  # pragma: no cover - future-jax guard
+    raise ImportError(
+        f"jax {jax.__version__}: neither pallas.tpu.CompilerParams nor "
+        "TPUCompilerParams exists; update kernels/pallas/_compat.py for "
+        "this jax version"
+    )

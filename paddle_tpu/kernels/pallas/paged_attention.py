@@ -34,6 +34,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._compat import CompilerParams as _CompilerParams
+
 NEG_INF = -1e30
 
 
@@ -147,7 +149,7 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
         out_shape=jax.ShapeDtypeStruct(
             (batch, n_kv_heads, group_pad, d), q.dtype
         ),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),

@@ -169,24 +169,32 @@ def rfftfreq(*, n, d=1.0, dtype=None):
 
 # ---- r5 signal framing (ref python/paddle/signal.py) ---------------------
 def frame(x, *, frame_length, hop_length, axis=-1):
-    """Slice overlapping frames along `axis` (ref signal.frame)."""
+    """Slice overlapping frames along `axis` (ref signal.frame).
+
+    Layout follows the reference: axis=-1 (or the positive last axis of a
+    >=2-D input) yields (..., frame_length, num_frames); axis=0 yields
+    (num_frames, frame_length, ...). The SIGNED axis decides for 1-D
+    input, where 0 and -1 name the same dim but opposite layouts — the
+    old ``axis in (-1, ndim - 1)`` test wrongly transposed the 1-D
+    axis=0 case."""
     import jax.numpy as jnp
 
-    n = x.shape[axis]
+    ax = axis + x.ndim if axis < 0 else axis
+    n = x.shape[ax]
     num = 1 + (n - frame_length) // hop_length
     starts = jnp.arange(num) * hop_length
     idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # [num, fl]
-    framed = jnp.take(x, idx.reshape(-1), axis=axis)
+    framed = jnp.take(x, idx.reshape(-1), axis=ax)
     shape = list(x.shape)
-    shape[axis if axis >= 0 else x.ndim + axis] = num
     framed = framed.reshape(
-        tuple(shape[:axis if axis >= 0 else x.ndim + axis])
-        + (num, frame_length)
-        + tuple(shape[(axis if axis >= 0 else x.ndim + axis) + 1:])
+        tuple(shape[:ax]) + (num, frame_length) + tuple(shape[ax + 1:])
     )
-    # ref layout: frame_length BEFORE num_frames on the last two dims
-    return jnp.swapaxes(framed, -1, -2) if axis in (-1, x.ndim - 1) \
-        else framed
+    # ref layout: frame_length BEFORE num_frames when framing the LAST
+    # axis. For 1-D input the SIGNED axis decides (axis=-1 -> last-axis
+    # layout, axis=0 -> leading layout); other negative non-last axes
+    # (e.g. axis=-2 of a 3-D input) keep the unswapped layout.
+    last = ax == x.ndim - 1 and (axis < 0 or x.ndim > 1)
+    return jnp.swapaxes(framed, -1, -2) if last else framed
 
 
 def overlap_add(x, *, hop_length, axis=-1):

@@ -113,13 +113,70 @@ class LBFGS(Optimizer):
                 if getattr(p, "trainable", not p.stop_gradient)]
 
     def _gather_flat_grad(self):
-        gs = []
-        for p in self._params():
-            g = p.grad._data if p.grad is not None else jnp.zeros_like(
-                p._data
+        """Flat gradient view, with the optimizer-level grad_clip and
+        weight_decay APPLIED (they were silently discarded pre-r6) in the
+        base Optimizer's order — clip the raw grads first, THEN add the
+        coupled L1/L2 decay term (the decay contribution is never
+        clipped, matching _make_step_fn) — so the two-loop direction and
+        the Wolfe search see the same effective gradient every other
+        optimizer steps on. The matching objective term lives in
+        ``_decay_loss`` (the line search must evaluate the function this
+        is the gradient of)."""
+        params = self._params()
+        gs = [
+            p.grad._data if p.grad is not None
+            else jnp.zeros_like(p._data)
+            for p in params
+        ]
+        if self._grad_clip is not None:
+            gs = self._grad_clip._clip_arrays(
+                [p._data for p in params], gs,
+                [getattr(p, "need_clip", True) for p in params],
             )
-            gs.append(g)
-        return _flatten(gs)
+        out = []
+        for p, g, (kind, coeff) in zip(params, gs, self._decay_cfg()):
+            if kind == "l2" and coeff:
+                g = g + coeff * p._data.astype(g.dtype)
+            elif kind == "l1" and coeff:
+                g = g + coeff * jnp.sign(p._data).astype(g.dtype)
+            out.append(g)
+        return _flatten(out)
+
+    def _decay_cfg(self):
+        """Per-param (kind, coeff), with the base Optimizer's override
+        rule: a param-level regularizer — even a falsy one like 0.0 —
+        beats the optimizer default (ref Optimizer._collect)."""
+        from .optimizer import _normalize_weight_decay
+
+        out = []
+        for p in self._params():
+            preg = getattr(p, "regularizer", None)
+            out.append(_normalize_weight_decay(
+                preg if preg is not None else self._default_weight_decay
+            ))
+        return out
+
+    def _decay_loss(self):
+        """The objective term whose gradient ``_gather_flat_grad`` adds
+        (l2: coeff/2*||p||^2, l1: coeff*|p|_1). Added to every closure
+        evaluation so the strong-Wolfe conditions compare f and g of the
+        SAME function — without it the decay direction never shows up in
+        f and the zoom drives alpha to ~0."""
+        total = None
+        for p, (kind, coeff) in zip(self._params(), self._decay_cfg()):
+            if kind == "l2" and coeff:
+                term = 0.5 * coeff * jnp.sum(
+                    jnp.square(p._data.astype(jnp.float32))
+                )
+            elif kind == "l1" and coeff:
+                term = coeff * jnp.sum(
+                    jnp.abs(p._data.astype(jnp.float32))
+                )
+            else:
+                continue
+            total = term if total is None else total + term
+        # one device->host sync for the whole decay term, not one per param
+        return float(total) if total is not None else 0.0
 
     def _set_flat_params(self, flat):
         offset = 0
@@ -204,7 +261,12 @@ class LBFGS(Optimizer):
             with autograd.enable_grad():
                 loss = closure()
             self._func_evals += 1
-            return float(loss.numpy()), self._gather_flat_grad()
+            # the decay objective term keeps f consistent with the
+            # decayed gradient the line search differentiates
+            return (
+                float(loss.numpy()) + self._decay_loss(),
+                self._gather_flat_grad(),
+            )
 
         def eval_at(flat_x):
             self._set_flat_params(flat_x)

@@ -1,0 +1,29 @@
+"""paddle_tpu.serving — continuous-batching LLM serving with a paged KV
+cache.
+
+The multi-tenant layer over the single-stream decode path: ``generation``
+gives one request a compiled decode loop; this package gives MANY requests
+one fixed-shape compiled step (Orca-style iteration-level scheduling) over
+a vLLM-style ref-counted block pool (``kv_cache``), with per-request
+sampling (``request``/``sampler``, reusing ``generation.warp_logits``) and
+engine counters pluggable into the profiler (``metrics``). See
+docs/serving.md for the architecture walkthrough.
+
+    from paddle_tpu import serving
+
+    engine = serving.Engine(model, serving.EngineConfig(
+        max_batch_slots=8, max_model_len=512, page_size=16))
+    outs = engine.generate(prompt_token_lists,
+                           serving.SamplingParams(max_new_tokens=64))
+"""
+from .adapter import LlamaServingAdapter, build_adapter
+from .engine import Engine, EngineConfig
+from .kv_cache import BlockManager, KVPool
+from .metrics import EngineMetrics
+from .request import Request, RequestOutput, RequestState, SamplingParams
+
+__all__ = [
+    "Engine", "EngineConfig", "SamplingParams", "Request", "RequestOutput",
+    "RequestState", "BlockManager", "KVPool", "EngineMetrics",
+    "LlamaServingAdapter", "build_adapter",
+]

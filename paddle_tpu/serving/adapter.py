@@ -1,0 +1,221 @@
+"""Model adapters: the compiled compute behind the serving engine.
+
+The engine schedules REQUESTS; an adapter turns one scheduler decision
+into array math over the paged KV pool. Two entry points, both pure
+functions of (weights, pool, scheduler arrays) so the engine can jit and
+donate them:
+
+  * ``prefill(w, kp, vp, ids, length, block_table)`` — run one prompt
+    (padded to a length bucket) through the model, WRITE its K/V into the
+    request's pages, return last-valid-position logits.
+  * ``decode(w, kp, vp, tokens, positions, block_tables, active)`` — one
+    token for every batch slot at once: write each token's K/V at its
+    per-slot position, attend over the per-slot block table, return
+    [slots, vocab] logits. Inactive slots are masked: their page write is
+    routed out of bounds (dropped by XLA scatter semantics, same trick as
+    ``paged_attention.update_pages``) and their logits are garbage the
+    engine never reads.
+
+``LlamaServingAdapter`` follows the ``models.llama.LlamaPipeline``
+precedent of re-owning the model's weights as raw arrays and rebuilding
+the block in jnp + ops.impl functions (the same math the Tensor ops
+dispatch to, so serving numerics match ``generate``'s). Decode attention
+uses the Pallas paged kernel on TPU and the XLA reference path elsewhere.
+
+Any object exposing the same five attributes and two methods (see
+``required_attrs``) can serve — the engine duck-types, it never imports a
+model class. An optional ``dtype`` attribute names the KV-pool dtype;
+without it the engine reads ``weights["embed"].dtype``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.impl.activation import swiglu as _swiglu
+from ..ops.impl.fused_ops import rope_qk as _rope_qk
+from ..ops.impl.nn_ops import (
+    scaled_dot_product_attention as _sdpa,
+)
+from ..ops.impl.nn_ops import rms_norm as _rms_norm
+
+__all__ = ["LlamaServingAdapter", "build_adapter", "required_attrs"]
+
+# the duck-typed adapter surface the engine relies on
+required_attrs = (
+    "num_layers", "num_kv_heads", "head_dim", "vocab_size", "weights",
+    "prefill", "decode",
+)
+
+
+def _paged_attn(q, kp, vp, block_tables, lengths):
+    # pallas imports stay function-scoped (the nn_ops.py pattern): plain
+    # `import paddle_tpu` must not load — nor fail on — the TPU kernel
+    # stack; these run at trace time only
+    from ..core import flags
+    from ..kernels.pallas.paged_attention import (
+        paged_attention,
+        paged_attention_xla,
+    )
+
+    if (jax.default_backend() == "tpu"
+            and flags.get_flag("FLAGS_use_pallas_kernels")):
+        return paged_attention(q, kp, vp, block_tables, lengths)
+    return paged_attention_xla(q, kp, vp, block_tables, lengths)
+
+
+def _write_prompt_pages(pages, kv, block_table, length):
+    """Scatter a prompt's [S, kv_heads, d] K or V into its pages. Token t
+    lands in page ``block_table[t // block_size]`` slot ``t % block_size``;
+    padded tail positions (t >= length) are routed to a nonexistent page
+    so the scatter drops them."""
+    n_blocks = pages.shape[1]
+    block_size = pages.shape[2]
+    s = kv.shape[0]
+    t = jnp.arange(s)
+    phys = block_table[t // block_size]
+    phys = jnp.where(t < length, phys, n_blocks)  # OOB -> dropped
+    slot = t % block_size
+    return pages.at[:, phys, slot].set(
+        jnp.swapaxes(kv, 0, 1).astype(pages.dtype)
+    )
+
+
+class LlamaServingAdapter:
+    """Paged-KV serving forward for a ``models.llama.LlamaForCausalLM``.
+
+    Snapshots the model's weights at construction (serving is inference;
+    call ``refresh()`` after a weight swap). Tied embeddings resolve the
+    LM head to ``embed.T`` inside the staged program.
+    """
+
+    def __init__(self, model):
+        cfg = model.config
+        if getattr(cfg, "num_experts", 0) > 0:
+            raise NotImplementedError(
+                "serving adapter: MoE Llama not supported yet (dense only)"
+            )
+        self.num_layers = cfg.num_hidden_layers
+        self.num_heads = cfg.num_attention_heads
+        self.num_kv_heads = cfg.num_key_value_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.hidden_size = cfg.hidden_size
+        self.vocab_size = cfg.vocab_size
+        self.rope_theta = cfg.rope_theta
+        self.eps = cfg.rms_norm_eps
+        self._model = model
+        self.refresh()
+
+    def refresh(self):
+        """Re-snapshot weights from the source model."""
+        m = self._model
+        layers = []
+        for blk in m.llama.layers:
+            layers.append({
+                "ln1": blk.input_layernorm.weight._data,
+                "wq": blk.self_attn.q_proj.weight._data,
+                "wk": blk.self_attn.k_proj.weight._data,
+                "wv": blk.self_attn.v_proj.weight._data,
+                "wo": blk.self_attn.o_proj.weight._data,
+                "ln2": blk.post_attention_layernorm.weight._data,
+                "wg": blk.mlp.gate_proj.weight._data,
+                "wu": blk.mlp.up_proj.weight._data,
+                "wd": blk.mlp.down_proj.weight._data,
+            })
+        self.weights = {
+            "embed": m.llama.embed_tokens.weight._data,
+            "layers": layers,
+            "norm": m.llama.norm.weight._data,
+            "head": (
+                m.lm_head.weight._data if m.lm_head is not None else None
+            ),
+        }
+        self.dtype = self.weights["embed"].dtype  # KV pool dtype
+
+    # -- shared block math ---------------------------------------------------
+    def _qkv(self, wl, h, b, s):
+        q = (h @ wl["wq"]).reshape(b, s, self.num_heads, self.head_dim)
+        k = (h @ wl["wk"]).reshape(b, s, self.num_kv_heads, self.head_dim)
+        v = (h @ wl["wv"]).reshape(b, s, self.num_kv_heads, self.head_dim)
+        return q, k, v
+
+    def _mlp(self, wl, x):
+        h = _rms_norm(x, wl["ln2"], epsilon=self.eps)
+        return x + _swiglu(h @ wl["wg"], h @ wl["wu"]) @ wl["wd"]
+
+    def _logits(self, w, x):
+        head = w["head"]
+        if head is None:
+            head = jnp.swapaxes(w["embed"], 0, 1)
+        return x @ head
+
+    # -- the two serving entry points ---------------------------------------
+    def prefill(self, w, kp, vp, ids, length, block_table):
+        """ids [S] (padded to a bucket), length scalar, block_table [P].
+        Returns (logits [vocab] at position length-1, kp, vp)."""
+        s = ids.shape[0]
+        x = w["embed"][ids][None]                      # [1, S, hid]
+        pos = jnp.arange(s, dtype=jnp.int32)[None]     # prompts start at 0
+        kp, vp = list(kp), list(vp)
+        for li in range(self.num_layers):
+            wl = w["layers"][li]
+            h = _rms_norm(x, wl["ln1"], epsilon=self.eps)
+            q, k, v = self._qkv(wl, h, 1, s)
+            q, k = _rope_qk(q, k, pos, base=self.rope_theta)
+            kp[li] = _write_prompt_pages(kp[li], k[0], block_table, length)
+            vp[li] = _write_prompt_pages(vp[li], v[0], block_table, length)
+            if self.num_kv_heads != self.num_heads:
+                rep = self.num_heads // self.num_kv_heads
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            # causal attention over the in-flight prompt; right-padding is
+            # invisible to valid queries under causality
+            attn = _sdpa(q, k, v, is_causal=True)
+            x = x + attn.reshape(1, s, -1) @ wl["wo"]
+            x = self._mlp(wl, x)
+        x = _rms_norm(x, w["norm"], epsilon=self.eps)
+        h_last = jnp.take(x[0], length - 1, axis=0)    # [hid]
+        return self._logits(w, h_last), tuple(kp), tuple(vp)
+
+    def decode(self, w, kp, vp, tokens, positions, block_tables, active):
+        """tokens/positions [slots], block_tables [slots, P], active
+        [slots] bool. Returns (logits [slots, vocab], kp, vp)."""
+        from ..kernels.pallas.paged_attention import update_pages
+
+        b = tokens.shape[0]
+        capacity = block_tables.shape[1] * kp[0].shape[2]
+        # inactive slots: write position at capacity -> update_pages drops
+        write_pos = jnp.where(active, positions, capacity)
+        lengths = positions + 1   # the new token attends to itself
+        x = w["embed"][tokens]                         # [slots, hid]
+        kp, vp = list(kp), list(vp)
+        for li in range(self.num_layers):
+            wl = w["layers"][li]
+            h = _rms_norm(x, wl["ln1"], epsilon=self.eps)
+            q, k, v = self._qkv(wl, h[:, None, :], b, 1)
+            q, k = _rope_qk(q, k, positions[:, None], base=self.rope_theta)
+            kp[li], vp[li] = update_pages(
+                kp[li], vp[li], k[:, 0], v[:, 0], block_tables, write_pos
+            )
+            attn = _paged_attn(
+                q[:, 0], kp[li], vp[li], block_tables, lengths
+            )                                          # [slots, heads, d]
+            x = x + attn.reshape(b, -1) @ wl["wo"]
+            x = self._mlp(wl, x)
+        x = _rms_norm(x, w["norm"], epsilon=self.eps)
+        return self._logits(w, x), tuple(kp), tuple(vp)
+
+
+def build_adapter(model):
+    """Resolve the adapter for ``model``: pass-through for objects already
+    exposing the adapter surface, ``LlamaServingAdapter`` for Llama."""
+    if all(hasattr(model, a) for a in required_attrs):
+        return model
+    from ..models.llama import LlamaForCausalLM
+
+    if isinstance(model, LlamaForCausalLM):
+        return LlamaServingAdapter(model)
+    raise TypeError(
+        f"cannot serve {type(model).__name__}: pass an adapter exposing "
+        f"{required_attrs} or a LlamaForCausalLM"
+    )

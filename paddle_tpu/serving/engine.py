@@ -1,0 +1,424 @@
+"""Continuous-batching LLM serving engine.
+
+The Orca (OSDI '22) iteration-level scheduler on TPU-native constraints:
+every XLA program must have a FIXED shape, so the batch is a static array
+of ``max_batch_slots`` slots and occupancy is data, not shape — requests
+join and leave mid-flight by mutating the slot arrays (tokens, positions,
+block tables, active mask) while the compiled step is reused unchanged.
+Two program families cover the whole serving loop after warmup (each in
+a greedy-only and, when a sampled request is present, a with-sampler
+variant — the mode is a static compile key, so an all-greedy fleet never
+pays the vocab-wide sampling warp):
+
+  * PREFILL: one prompt, padded to a length bucket
+    (``jit.bucketing.next_bucket`` policy — at most len(buckets)
+    compiles), writes the prompt's K/V into its pages and samples the
+    first token.
+  * DECODE: one token for every slot at once over the paged KV pool
+    (``kv_cache.KVPool`` + per-request block tables), batched per-slot
+    sampling (``sampler.sample_tokens``), one compile total.
+
+Scheduling policy (host-side, cheap):
+  * admission control — FCFS from the waiting queue into free slots,
+    gated on KV blocks for the whole prompt plus one decode step;
+    ``max_waiting`` bounds the queue.
+  * block growth — each decode step first ensures every running request
+    owns a block for the token it is about to write; on pool exhaustion
+    the YOUNGEST running request is preempted (blocks freed, request
+    requeued at the head). Preemption is recompute-style: the victim's
+    tokens are kept and its cache is rebuilt by a later prefill over
+    ``prompt + output[:-1]``, which restores its state exactly — greedy
+    outputs are unchanged by preemption.
+
+Engine counters live in ``metrics.EngineMetrics``; the compile counters
+are incremented inside the traced step bodies, so they move only when XLA
+actually retraces — the probe behind the no-recompile-after-warmup
+guarantee.
+"""
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..jit.bucketing import next_bucket
+from ..profiler import RecordEvent
+from .adapter import build_adapter
+from .kv_cache import BlockManager, KVPool
+from .metrics import EngineMetrics
+from .request import Request, RequestOutput, RequestState, SamplingParams
+from .sampler import pack_sampling_params, sample_tokens
+
+__all__ = ["Engine", "EngineConfig"]
+
+
+def _default_buckets(max_model_len):
+    """Doubling ladder from 16 (or smaller) up to max_model_len."""
+    buckets = []
+    b = min(16, max_model_len)
+    while b < max_model_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_model_len)
+    return buckets
+
+
+class EngineConfig:
+    def __init__(self, max_batch_slots=8, max_model_len=2048, page_size=16,
+                 num_blocks=None, prefill_buckets=None, max_waiting=None,
+                 seed=0):
+        if max_batch_slots < 1:
+            raise ValueError("max_batch_slots must be >= 1")
+        if page_size < 1 or max_model_len < 2:
+            raise ValueError("need page_size >= 1 and max_model_len >= 2")
+        self.max_batch_slots = int(max_batch_slots)
+        self.max_model_len = int(max_model_len)
+        self.page_size = int(page_size)
+        self.pages_per_seq = -(-self.max_model_len // self.page_size)
+        self.num_blocks = int(
+            num_blocks if num_blocks is not None
+            else self.max_batch_slots * self.pages_per_seq
+        )
+        if self.num_blocks < self.pages_per_seq:
+            raise ValueError(
+                f"num_blocks ({self.num_blocks}) cannot hold even one "
+                f"max-length request ({self.pages_per_seq} pages)"
+            )
+        self.prefill_buckets = sorted(
+            int(b) for b in (prefill_buckets
+                             or _default_buckets(self.max_model_len))
+        )
+        if self.prefill_buckets[-1] < self.max_model_len:
+            raise ValueError(
+                "largest prefill bucket must cover max_model_len "
+                f"({self.prefill_buckets[-1]} < {self.max_model_len})"
+            )
+        if max_waiting is not None and max_waiting < 1:
+            raise ValueError(
+                f"max_waiting must be >= 1 or None (unbounded), got "
+                f"{max_waiting}"
+            )
+        self.max_waiting = max_waiting
+        self.seed = int(seed)
+
+
+class Engine:
+    """Multi-tenant serving over a single model replica.
+
+        engine = serving.Engine(model, serving.EngineConfig(...))
+        engine.add_request([1, 2, 3], serving.SamplingParams(max_new_tokens=8))
+        while engine.has_unfinished():
+            for out in engine.step():
+                print(out.request_id, out.token_ids)
+    """
+
+    def __init__(self, model, config=None):
+        self.config = config or EngineConfig()
+        self.adapter = build_adapter(model)
+        self.metrics = EngineMetrics()
+        cfg = self.config
+        # pool dtype: the adapter may declare it; default to the embed
+        # table's dtype for dict-shaped weights (the Llama adapter)
+        dtype = getattr(self.adapter, "dtype", None)
+        if dtype is None:
+            dtype = self.adapter.weights["embed"].dtype
+        self.pool = KVPool(
+            self.adapter.num_layers, self.adapter.num_kv_heads,
+            cfg.num_blocks, cfg.page_size, self.adapter.head_dim, dtype,
+        )
+        self.block_manager = BlockManager(cfg.num_blocks, cfg.page_size)
+        self.waiting: collections.deque = collections.deque()
+        self.slots: list = [None] * cfg.max_batch_slots
+        self._admit_counter = 0
+        self._key_counter = 0
+        self._base_key = jax.random.PRNGKey(cfg.seed)
+        self._build_steps()
+
+    # -- compiled steps ------------------------------------------------------
+    def _build_steps(self):
+        adapter, metrics = self.adapter, self.metrics
+        # donation keeps the pool single-buffered on TPU; CPU PJRT ignores
+        # donation (and warns), so skip it there
+        donate = (1, 2) if jax.default_backend() == "tpu" else ()
+
+        # ``any_sample`` is STATIC (python bool): an all-greedy batch —
+        # the common serving case — compiles a program with no sampling
+        # warp at all, instead of computing and discarding it. At most
+        # two decode programs exist (greedy-only and mixed).
+
+        def prefill_fn(w, kp, vp, ids, length, block_table,
+                       temperature, top_k, top_p, do_sample, key,
+                       any_sample):
+            metrics.prefill_compiles += 1   # traced-body compile probe
+            logits, kp, vp = adapter.prefill(
+                w, kp, vp, ids, length, block_table
+            )
+            u = (
+                jax.random.uniform(
+                    key, (1,) + logits.shape, jnp.float32, 1e-9, 1.0
+                ) if any_sample else None
+            )
+            tok = sample_tokens(
+                logits[None], temperature[None], top_k[None], top_p[None],
+                do_sample[None], u,
+            )
+            return tok[0], kp, vp
+
+        def decode_fn(w, kp, vp, tokens, positions, block_tables, active,
+                      temperature, top_k, top_p, do_sample, key,
+                      any_sample):
+            metrics.decode_compiles += 1    # traced-body compile probe
+            logits, kp, vp = adapter.decode(
+                w, kp, vp, tokens, positions, block_tables, active
+            )
+            u = (
+                jax.random.uniform(
+                    key, logits.shape, jnp.float32, 1e-9, 1.0
+                ) if any_sample else None
+            )
+            nxt = sample_tokens(
+                logits, temperature, top_k, top_p, do_sample, u
+            )
+            return nxt, kp, vp
+
+        self._prefill_jit = jax.jit(
+            prefill_fn, donate_argnums=donate, static_argnums=(11,)
+        )
+        self._decode_jit = jax.jit(
+            decode_fn, donate_argnums=donate, static_argnums=(12,)
+        )
+
+    def _next_key(self):
+        self._key_counter += 1
+        return jax.random.fold_in(self._base_key, self._key_counter)
+
+    # -- client API ----------------------------------------------------------
+    def add_request(self, prompt_token_ids, sampling_params=None,
+                    request_id=None):
+        cfg = self.config
+        if (cfg.max_waiting is not None
+                and len(self.waiting) >= cfg.max_waiting):
+            raise RuntimeError(
+                f"admission queue full ({cfg.max_waiting} waiting)"
+            )
+        req = Request(prompt_token_ids, sampling_params, request_id)
+        if len(req.prompt_token_ids) >= cfg.max_model_len:
+            raise ValueError(
+                f"prompt of {len(req.prompt_token_ids)} tokens leaves no "
+                f"room to generate under max_model_len={cfg.max_model_len}"
+            )
+        self.waiting.append(req)
+        self.metrics.requests_received += 1
+        return req
+
+    def abort(self, request_id):
+        """Drop a request wherever it is; returns True if found."""
+        for req in list(self.waiting):
+            if req.request_id == request_id:
+                self.waiting.remove(req)
+                req.state = RequestState.FINISHED
+                req.finish_reason = "aborted"
+                return True
+        for req in self.slots:
+            if req is not None and req.request_id == request_id:
+                self._release(req)
+                req.state = RequestState.FINISHED
+                req.finish_reason = "aborted"
+                return True
+        return False
+
+    def has_unfinished(self):
+        return bool(self.waiting) or any(
+            r is not None for r in self.slots
+        )
+
+    def generate(self, prompts, sampling_params=None):
+        """Convenience driver: submit everything, step until drained,
+        return RequestOutputs in submission order. ``sampling_params`` may
+        be one SamplingParams for all prompts or a list per prompt.
+        Submission respects ``max_waiting`` by feeding the queue as it
+        drains instead of raising mid-batch."""
+        if isinstance(sampling_params, (list, tuple)):
+            if len(sampling_params) != len(prompts):
+                raise ValueError("one SamplingParams per prompt required")
+            params = sampling_params
+        else:
+            params = [sampling_params] * len(prompts)
+        cap = self.config.max_waiting
+        pending = collections.deque(zip(prompts, params))
+        reqs, done = [], {}
+        while pending or self.has_unfinished():
+            while pending and (cap is None or len(self.waiting) < cap):
+                p, sp = pending.popleft()
+                reqs.append(self.add_request(p, sp))
+            for out in self.step():
+                done[out.request_id] = out
+        return [done[r.request_id] for r in reqs]
+
+    # -- scheduler -----------------------------------------------------------
+    def step(self):
+        """One scheduler iteration: admit + prefill joiners, then one
+        decode step over the occupied slots. Returns RequestOutputs for
+        requests that finished during this step."""
+        finished: list = []
+        self._admit(finished)
+        if any(r is not None for r in self.slots):
+            self._ensure_capacity()
+            if any(r is not None for r in self.slots):
+                self._decode(finished)
+        m, bm = self.metrics, self.block_manager
+        m.queue_depth = len(self.waiting)
+        m.num_running = sum(r is not None for r in self.slots)
+        m.cache_utilization = bm.utilization()
+        m.pool_high_water = bm.high_water
+        return finished
+
+    def _admit(self, finished):
+        cfg, bm = self.config, self.block_manager
+        while self.waiting and None in self.slots:
+            req = self.waiting[0]
+            tokens = req.tokens_to_prefill()
+            # admission control: the whole prompt plus the next decode
+            # write must fit, or the request stays queued (FCFS)
+            if not bm.can_allocate(bm.blocks_needed(len(tokens) + 1)):
+                break
+            self.waiting.popleft()
+            req.block_ids = bm.allocate(bm.blocks_needed(len(tokens) + 1))
+            req.slot = self.slots.index(None)
+            self.slots[req.slot] = req
+            req.state = RequestState.RUNNING
+            req.admit_seq = self._admit_counter
+            self._admit_counter += 1
+            self._prefill(req, tokens)
+            reason = req.check_stop(cfg.max_model_len)
+            if reason:
+                self._finish(req, reason, finished)
+
+    def _prefill(self, req, tokens):
+        import time
+
+        cfg = self.config
+        bucket = next_bucket(len(tokens), cfg.prefill_buckets)
+        ids = np.zeros(bucket, np.int32)
+        ids[: len(tokens)] = tokens
+        table = np.zeros(cfg.pages_per_seq, np.int32)
+        table[: len(req.block_ids)] = req.block_ids
+        p = req.sampling_params
+        with RecordEvent("serving.prefill"):
+            tok, k, v = self._prefill_jit(
+                self.adapter.weights, self.pool.k, self.pool.v,
+                ids, np.int32(len(tokens)), table,
+                np.float32(p.temperature), np.int32(p.top_k),
+                np.float32(p.top_p), np.bool_(p.do_sample),
+                self._next_key(), bool(p.do_sample),
+            )
+            tok = int(tok)
+        self.pool.rebind(k, v)
+        req.num_cached = len(tokens)
+        self.metrics.prefill_tokens += len(tokens)
+        self.metrics.prefill_steps += 1
+        if req.output_token_ids:
+            # resumed after preemption: the sampled token re-derives
+            # output[-1]; keep the one we already have
+            req.last_token = req.output_token_ids[-1]
+        else:
+            req.first_token_time = time.perf_counter()
+            self.metrics.record_ttft(
+                req.first_token_time - req.arrival_time
+            )
+            req.output_token_ids.append(tok)
+            req.last_token = tok
+
+    def _ensure_capacity(self):
+        """Every running request needs a block for the KV slot its next
+        decode step writes; steal from the youngest on exhaustion."""
+        bm = self.block_manager
+        for req in sorted(
+            (r for r in self.slots if r is not None),
+            key=lambda r: r.admit_seq,
+        ):
+            if req.state is not RequestState.RUNNING:
+                continue  # preempted by an older request this pass
+            need = bm.blocks_needed(req.num_cached + 1)
+            while len(req.block_ids) < need:
+                if bm.can_allocate(1):
+                    req.block_ids += bm.allocate(1)
+                    continue
+                victims = [
+                    r for r in self.slots
+                    if r is not None and r is not req
+                ]
+                if not victims:
+                    raise RuntimeError(
+                        "KV pool exhausted by a single request; "
+                        "EngineConfig.num_blocks is too small for "
+                        "max_model_len"
+                    )
+                self._preempt(max(victims, key=lambda r: r.admit_seq))
+
+    def _preempt(self, req):
+        self._release(req)
+        req.state = RequestState.WAITING
+        req.num_cached = 0
+        self.waiting.appendleft(req)
+        self.metrics.preemptions += 1
+
+    def _decode(self, finished):
+        cfg = self.config
+        n = cfg.max_batch_slots
+        tokens = np.zeros(n, np.int32)
+        positions = np.zeros(n, np.int32)
+        tables = np.zeros((n, cfg.pages_per_seq), np.int32)
+        active = np.zeros(n, bool)
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            tokens[i] = req.last_token
+            positions[i] = req.num_cached
+            tables[i, : len(req.block_ids)] = req.block_ids
+            active[i] = True
+        params = pack_sampling_params(self.slots)
+        with RecordEvent("serving.decode"):
+            nxt, k, v = self._decode_jit(
+                self.adapter.weights, self.pool.k, self.pool.v,
+                tokens, positions, tables, active,
+                params["temperature"], params["top_k"], params["top_p"],
+                params["do_sample"], self._next_key(),
+                bool(params["do_sample"].any()),
+            )
+            nxt = np.asarray(nxt)
+        self.pool.rebind(k, v)
+        self.metrics.decode_steps += 1
+        for i, req in enumerate(list(self.slots)):
+            if req is None:
+                continue
+            req.num_cached += 1
+            tok = int(nxt[i])
+            req.output_token_ids.append(tok)
+            req.last_token = tok
+            self.metrics.decode_tokens += 1
+            reason = req.check_stop(cfg.max_model_len)
+            if reason:
+                self._finish(req, reason, finished)
+
+    # -- teardown ------------------------------------------------------------
+    def _release(self, req):
+        """Free the request's KV blocks and vacate its slot."""
+        if req.block_ids:
+            self.block_manager.free(req.block_ids)
+            req.block_ids = []
+        if req.slot is not None:
+            self.slots[req.slot] = None
+            req.slot = None
+
+    def _finish(self, req, reason, finished):
+        import time
+
+        req.finish_reason = reason
+        req.state = RequestState.FINISHED
+        req.finish_time = time.perf_counter()
+        self._release(req)
+        self.metrics.requests_finished += 1
+        finished.append(RequestOutput(req))

@@ -1,0 +1,116 @@
+"""Paged KV-cache: ref-counted block manager over a preallocated pool.
+
+The vLLM/PagedAttention (SOSP '23) memory design on the TPU-native page
+layout already used by ``kernels/pallas/paged_attention``: the physical
+cache is ONE preallocated array per layer,
+``[num_kv_heads, num_blocks, block_size, head_dim]``, and every request
+owns an ordered list of block ids (its block table). Because blocks are
+ref-counted, a future prefix-sharing pass only needs ``fork()`` — two
+requests mapping the same prompt blocks — with copy-on-write left to the
+caller; the free-list is LIFO so hot blocks are reused while still in
+cache.
+
+Allocation policy lives in the ENGINE (admission control, preemption);
+this module only enforces the invariants: a block is reusable exactly when
+its refcount returns to zero, and the pool's high-water mark is tracked so
+tests can assert blocks actually return to the free-list.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["BlockManager", "KVPool"]
+
+
+class BlockManager:
+    """Ref-counted free-list over ``num_blocks`` logical blocks of
+    ``block_size`` tokens each."""
+
+    def __init__(self, num_blocks, block_size):
+        if num_blocks < 1 or block_size < 1:
+            raise ValueError(
+                f"need num_blocks >= 1 and block_size >= 1, got "
+                f"{num_blocks}/{block_size}"
+            )
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # LIFO: most-recently-freed block is re-allocated first
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._ref = [0] * self.num_blocks
+        self.high_water = 0   # max blocks ever simultaneously in use
+
+    # -- accounting ---------------------------------------------------------
+    @property
+    def num_free(self):
+        return len(self._free)
+
+    @property
+    def num_used(self):
+        return self.num_blocks - len(self._free)
+
+    def utilization(self):
+        return self.num_used / self.num_blocks
+
+    def blocks_needed(self, num_tokens):
+        """Blocks required to hold ``num_tokens`` cache slots."""
+        return -(-int(num_tokens) // self.block_size)
+
+    def can_allocate(self, n):
+        return len(self._free) >= n
+
+    # -- lifecycle ----------------------------------------------------------
+    def allocate(self, n):
+        """Take ``n`` blocks off the free-list (refcount 1 each)."""
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV pool exhausted: need {n} blocks, {len(self._free)} "
+                f"free of {self.num_blocks}"
+            )
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
+        self.high_water = max(self.high_water, self.num_used)
+        return out
+
+    def fork(self, block_ids):
+        """Share existing blocks with a second owner (prefix sharing):
+        refcount++ per block, no data movement."""
+        for b in block_ids:
+            if self._ref[b] < 1:
+                raise RuntimeError(f"fork of free block {b}")
+            self._ref[b] += 1
+
+    def free(self, block_ids):
+        """Drop one reference per block; blocks return to the free-list
+        when the last owner releases them."""
+        for b in block_ids:
+            if self._ref[b] < 1:
+                raise RuntimeError(f"double free of block {b}")
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                self._free.append(b)
+
+
+class KVPool:
+    """The physical page pool: one (k, v) array pair per layer, each
+    ``[num_kv_heads, num_blocks, block_size, head_dim]`` — the exact
+    layout ``kernels/pallas/paged_attention`` consumes. Kept as per-layer
+    tuples (not stacked) so the engine can donate them through the
+    compiled step without reassembly."""
+
+    def __init__(self, num_layers, num_kv_heads, num_blocks, block_size,
+                 head_dim, dtype="float32"):
+        shape = (num_kv_heads, num_blocks, block_size, head_dim)
+        self.k = tuple(jnp.zeros(shape, dtype) for _ in range(num_layers))
+        self.v = tuple(jnp.zeros(shape, dtype) for _ in range(num_layers))
+        self.num_layers = num_layers
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+
+    def rebind(self, k, v):
+        """Adopt the updated pool arrays returned by a compiled step."""
+        self.k = tuple(k)
+        self.v = tuple(v)
+
+    def nbytes(self):
+        return sum(a.size * a.dtype.itemsize for a in self.k + self.v)

@@ -1,0 +1,75 @@
+"""Engine observability: counters + profiler integration.
+
+Counters cover the serving-quality quartet — queue depth, time-to-first-
+token, throughput, cache pressure — plus the two TPU-specific health
+signals: compile counts (a recompile after warmup means a shape leaked
+into the hot path) and preemptions (KV pool pressure). ``RecordEvent``
+spans from ``paddle_tpu.profiler`` wrap the prefill/decode steps, so a
+profiler session over a serving loop shows them in the UserDefined
+summary table and the trace viewer like any other annotated range.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["EngineMetrics"]
+
+
+class EngineMetrics:
+    def __init__(self):
+        self.start_time = time.perf_counter()
+        # request flow
+        self.requests_received = 0
+        self.requests_finished = 0
+        self.preemptions = 0
+        # token flow
+        self.prefill_tokens = 0
+        self.decode_tokens = 0
+        # step/compile accounting (compile counters are bumped from INSIDE
+        # the traced step body, so they move only when XLA retraces)
+        self.prefill_steps = 0
+        self.decode_steps = 0
+        self.prefill_compiles = 0
+        self.decode_compiles = 0
+        # gauges (updated by the engine each step)
+        self.queue_depth = 0
+        self.num_running = 0
+        self.cache_utilization = 0.0
+        self.pool_high_water = 0
+        # latency
+        self._ttft_sum = 0.0
+        self._ttft_count = 0
+
+    def record_ttft(self, seconds):
+        self._ttft_sum += seconds
+        self._ttft_count += 1
+
+    @property
+    def mean_ttft(self):
+        return (
+            self._ttft_sum / self._ttft_count if self._ttft_count else None
+        )
+
+    def tokens_per_second(self):
+        dt = time.perf_counter() - self.start_time
+        return (self.prefill_tokens + self.decode_tokens) / dt if dt else 0.0
+
+    def snapshot(self):
+        """One dict, stable keys — what a scrape endpoint would export."""
+        return {
+            "requests_received": self.requests_received,
+            "requests_finished": self.requests_finished,
+            "preemptions": self.preemptions,
+            "queue_depth": self.queue_depth,
+            "num_running": self.num_running,
+            "prefill_tokens": self.prefill_tokens,
+            "decode_tokens": self.decode_tokens,
+            "prefill_steps": self.prefill_steps,
+            "decode_steps": self.decode_steps,
+            "prefill_compiles": self.prefill_compiles,
+            "decode_compiles": self.decode_compiles,
+            "cache_utilization": self.cache_utilization,
+            "pool_high_water": self.pool_high_water,
+            "mean_ttft_s": self.mean_ttft,
+            "tokens_per_s": self.tokens_per_second(),
+        }

@@ -1,0 +1,149 @@
+"""Request lifecycle for the serving engine.
+
+Capability target: the request/sequence abstractions of continuous-batching
+servers (Orca OSDI'22 iteration-level scheduling; vLLM SequenceGroup), cut
+down to what a single-replica TPU engine needs: per-request sampling
+params, token accounting, and stop conditions. Stop semantics mirror
+``generation.GenerationMixin.generate`` — the stop token itself is kept in
+the output (generate emits EOS then pads), so a request served through the
+engine and one served through ``generate`` produce the same token stream.
+"""
+from __future__ import annotations
+
+import enum
+import itertools
+import time
+
+__all__ = ["RequestState", "SamplingParams", "Request", "RequestOutput"]
+
+
+class RequestState(enum.Enum):
+    WAITING = 0     # queued (never scheduled, or preempted back to queue)
+    RUNNING = 1     # owns a batch slot + KV blocks
+    FINISHED = 2
+
+
+class SamplingParams:
+    """Per-request sampling knobs, the serving-side analogue of
+    ``generation.GenerationConfig`` (same field semantics — greedy unless
+    ``do_sample``; warps are temperature -> top-k -> top-p)."""
+
+    def __init__(self, max_new_tokens=16, do_sample=False, temperature=1.0,
+                 top_k=0, top_p=1.0, eos_token_id=None, stop_token_ids=()):
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        if temperature <= 0.0:
+            raise ValueError(
+                f"temperature must be > 0 (got {temperature}); use "
+                "do_sample=False for greedy decoding"
+            )
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0 (0 disables), got {top_k}")
+        self.max_new_tokens = int(max_new_tokens)
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos_token_id = eos_token_id
+        self.stop_token_ids = tuple(int(t) for t in stop_token_ids)
+
+    @property
+    def stop_ids(self):
+        """The full stop set: explicit stop tokens plus EOS."""
+        ids = set(self.stop_token_ids)
+        if self.eos_token_id is not None:
+            ids.add(int(self.eos_token_id))
+        return ids
+
+
+_request_counter = itertools.count()
+
+
+class Request:
+    """One in-flight generation. The engine owns the mutable scheduling
+    fields; ``output_token_ids`` accumulates generated tokens (including a
+    terminal stop token, matching ``generate``'s EOS handling).
+
+    KV invariant while RUNNING: the cache holds ``num_cached`` tokens =
+    prompt + all generated tokens EXCEPT ``last_token`` (the newest token
+    is written by the decode step that consumes it). Preemption frees the
+    blocks but keeps the token state, so a re-prefill over
+    ``prompt + output[:-1]`` restores the cache exactly.
+    """
+
+    def __init__(self, prompt_token_ids, sampling_params=None,
+                 request_id=None):
+        prompt_token_ids = [int(t) for t in prompt_token_ids]
+        if not prompt_token_ids:
+            raise ValueError("prompt_token_ids must be non-empty")
+        self.request_id = (
+            request_id if request_id is not None
+            else next(_request_counter)
+        )
+        self.prompt_token_ids = prompt_token_ids
+        self.sampling_params = sampling_params or SamplingParams()
+        self.state = RequestState.WAITING
+        self.output_token_ids: list = []
+        self.finish_reason = None
+        # scheduling fields (engine-owned while RUNNING)
+        self.block_ids: list = []
+        self.num_cached = 0       # tokens whose KV is in the pool
+        self.last_token = None    # newest token, not yet in the cache
+        self.slot = None
+        self.admit_seq = -1       # admission order, for preemption policy
+        # metrics
+        self.arrival_time = time.perf_counter()
+        self.first_token_time = None
+        self.finish_time = None
+
+    @property
+    def num_tokens(self):
+        return len(self.prompt_token_ids) + len(self.output_token_ids)
+
+    def tokens_to_prefill(self):
+        """Tokens whose KV must be (re)built by a prefill: the prompt plus
+        every generated token except the newest (see class invariant)."""
+        return self.prompt_token_ids + self.output_token_ids[:-1]
+
+    def check_stop(self, max_model_len):
+        """Return a finish reason for the current state, or None. Called
+        after each appended token, mirroring generate's loop order (stop
+        token beats length when both trigger on the same token)."""
+        p = self.sampling_params
+        if self.output_token_ids and (
+            self.output_token_ids[-1] in p.stop_ids
+        ):
+            return "stop"
+        if len(self.output_token_ids) >= p.max_new_tokens:
+            return "length"
+        if self.num_tokens >= max_model_len:
+            return "length"
+        return None
+
+
+class RequestOutput:
+    """Immutable result handed back by the engine."""
+
+    def __init__(self, request):
+        self.request_id = request.request_id
+        self.prompt_token_ids = list(request.prompt_token_ids)
+        self.token_ids = list(request.output_token_ids)
+        self.finish_reason = request.finish_reason
+        self.time_to_first_token = (
+            request.first_token_time - request.arrival_time
+            if request.first_token_time is not None else None
+        )
+        self.latency = (
+            request.finish_time - request.arrival_time
+            if request.finish_time is not None else None
+        )
+
+    def __repr__(self):
+        return (
+            f"RequestOutput(id={self.request_id}, "
+            f"n_out={len(self.token_ids)}, reason={self.finish_reason!r})"
+        )

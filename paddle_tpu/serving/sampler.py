@@ -1,0 +1,63 @@
+"""Batched masked sampling for the continuous batch.
+
+Reuses ``generation.warp_logits`` — the exact warp math behind
+``GenerationMixin.generate`` — with per-slot parameter VECTORS instead of
+scalars, so one [slots, vocab] program samples every occupant of the batch
+at once (heterogeneous temperature/top-k/top-p across slots, no per-request
+dispatch). Greedy rows bypass the warp via a final ``where`` on the
+``do_sample`` mask, which keeps greedy serving bit-identical to
+``generate``'s ``F.argmax`` path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..generation import warp_logits
+
+__all__ = ["sample_tokens", "pack_sampling_params"]
+
+
+def sample_tokens(logits, temperature, top_k, top_p, do_sample, u=None):
+    """Next token per slot on [slots, vocab] logits.
+
+    ``temperature/top_k/top_p/do_sample``: [slots] arrays. ``u``: uniform
+    (0, 1] noise of logits' shape — passed in (rather than drawn here) so
+    the caller owns the RNG stream; the Gumbel trick then matches
+    ``generation._sample``. ``u=None`` declares the whole batch greedy
+    (a STATIC fact the engine knows host-side): the vocab-wide
+    sort/softmax warp is skipped entirely instead of computed and
+    discarded by the ``where``.
+    """
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if u is None:
+        return greedy
+    warped = warp_logits(logits, temperature, top_k, top_p)
+    gumbel = -jnp.log(-jnp.log(u))
+    sampled = jnp.argmax(warped + gumbel, axis=-1).astype(jnp.int32)
+    return jnp.where(jnp.asarray(do_sample), sampled, greedy)
+
+
+def pack_sampling_params(requests):
+    """Pack per-slot SamplingParams into fixed-shape host arrays (empty
+    slots get inert defaults). ``requests``: list of Request-or-None, one
+    per batch slot."""
+    n = len(requests)
+    temperature = np.ones(n, np.float32)
+    top_k = np.zeros(n, np.int32)
+    top_p = np.ones(n, np.float32)
+    do_sample = np.zeros(n, bool)
+    for i, r in enumerate(requests):
+        if r is None:
+            continue
+        p = r.sampling_params
+        temperature[i] = p.temperature
+        top_k[i] = p.top_k
+        top_p[i] = p.top_p
+        do_sample[i] = p.do_sample
+    return {
+        "temperature": temperature,
+        "top_k": top_k,
+        "top_p": top_p,
+        "do_sample": do_sample,
+    }

@@ -240,9 +240,17 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
 def box_coder(prior_box, prior_box_var, target_box,
               code_type="encode_center_size", box_normalized=True,
               axis=0):
-    """Encode/decode boxes against priors (ref ops.py:584)."""
+    """Encode/decode boxes against priors (ref ops.py:584).
+
+    ``axis`` selects which axis of a 3-D decode target the 2-D prior
+    broadcasts along (the reference's contract; it is ignored for
+    encode): axis=0 pairs prior k with ``target_box[:, k]``, axis=1 with
+    ``target_box[k, :]``. Pre-r6 the argument was accepted but silently
+    ignored, producing wrong boxes for axis=1 inputs."""
     import jax.numpy as jnp
 
+    if axis not in (0, 1):
+        raise ValueError(f"box_coder axis must be 0 or 1, got {axis}")
     pb = prior_box._data if isinstance(prior_box, Tensor) \
         else jnp.asarray(prior_box)
     tb = target_box._data if isinstance(target_box, Tensor) \
@@ -255,6 +263,18 @@ def box_coder(prior_box, prior_box_var, target_box,
     ph_ = pb[..., 3] - pb[..., 1] + norm
     pcx = pb[..., 0] + pw * 0.5
     pcy = pb[..., 1] + ph_ * 0.5
+    if (code_type == "decode_center_size" and axis == 1
+            and tb.ndim == pb.ndim + 1):
+        # prior k decodes row k: insert the broadcast dim AFTER the prior
+        # axis instead of relying on trailing-dim alignment (which
+        # implements axis=0)
+        pw, ph_, pcx, pcy = (
+            a[..., :, None] for a in (pw, ph_, pcx, pcy)
+        )
+        if var.ndim == pb.ndim:
+            # per-prior variances follow the prior's broadcast dim; a
+            # 1-D [4] variance broadcasts over every box as-is
+            var = var[..., :, None, :]
     if code_type == "encode_center_size":
         tw = tb[..., 2] - tb[..., 0] + norm
         th = tb[..., 3] - tb[..., 1] + norm

@@ -398,6 +398,29 @@ class TestReviewRegressions2:
                 {"bad": object()}, str(tmp_path / "c8")
             )
 
+    def test_async_save_snapshots_before_mutation(self, tmp_path):
+        """async_save must deep-snapshot non-Tensor values BEFORE the
+        background writer starts: pre-r6 raw ndarrays and python
+        containers were held by reference, so training mutating them
+        after save_state_dict returned raced the writer thread."""
+        arr = np.arange(4, dtype=np.float32)
+        steps = [1, 2, 3]
+        dist.checkpoint.save_state_dict(
+            {"sched": arr, "steps": steps, "tag": "r6"},
+            str(tmp_path / "c9"), async_save=True,
+        )
+        # user mutates immediately after the call returns
+        arr += 100.0
+        steps.append(999)
+        dist.checkpoint.wait_async_save()
+        sd = {"sched": None, "steps": None, "tag": None}
+        dist.checkpoint.load_state_dict(sd, str(tmp_path / "c9"))
+        np.testing.assert_allclose(
+            sd["sched"].numpy(), np.arange(4, dtype=np.float32)
+        )
+        assert sd["steps"] == [1, 2, 3]
+        assert sd["tag"] == "r6"
+
     def test_launcher_waits_out_pod_on_failure(self, tmp_path):
         # one worker fails fast; the slow sibling must be reaped before
         # launch() returns

@@ -793,6 +793,31 @@ def test_op_grad(op):
     )
 
 
+def test_frame_1d_axis0():
+    """1-D frame with axis=0 must produce the (num_frames, frame_length)
+    layout — the axis normalization regression: ``axis in (-1, ndim-1)``
+    matched axis=0 when ndim == 1 and transposed the output."""
+    x = np.arange(8, dtype="float32")
+    out0 = F.frame(paddle.to_tensor(x), frame_length=4, hop_length=2,
+                   axis=0).numpy()
+    want = np.stack([x[0:4], x[2:6], x[4:8]])  # [num=3, fl=4]
+    assert out0.shape == (3, 4)
+    np.testing.assert_array_equal(out0, want)
+    # axis=-1 on the same 1-D input keeps the reference's transposed
+    # (frame_length, num_frames) layout
+    out1 = F.frame(paddle.to_tensor(x), frame_length=4, hop_length=2,
+                   axis=-1).numpy()
+    np.testing.assert_array_equal(out1, want.T)
+    # negative NON-last axes agree with their positive spelling (review
+    # finding: `axis < 0` alone misclassified axis=-2 as the last axis)
+    x3 = np.arange(60, dtype="float32").reshape(2, 10, 3)
+    a_neg = F.frame(paddle.to_tensor(x3), frame_length=4, hop_length=2,
+                    axis=-2).numpy()
+    a_pos = F.frame(paddle.to_tensor(x3), frame_length=4, hop_length=2,
+                    axis=1).numpy()
+    np.testing.assert_array_equal(a_neg, a_pos)
+
+
 def test_sweep_coverage():
     """Every yaml op is either swept or carries an explicit skip reason,
     and the sweep covers the >=300-op floor (VERDICT r4 item 6)."""

@@ -189,3 +189,45 @@ class TestLBFGS:
         opt = paddle.optimizer.LBFGS(parameters=m.parameters())
         with pytest.raises(TypeError, match="closure"):
             opt.step()
+
+    def test_weight_decay_applied(self):
+        """Pre-r6 LBFGS silently discarded weight_decay; with a constant
+        loss the ONLY gradient is the decay term, so the param must
+        shrink toward zero."""
+        p = paddle.to_tensor(np.array([2.0, -3.0], "float32"))
+        p.stop_gradient = False
+        opt = paddle.optimizer.LBFGS(
+            parameters=[p], learning_rate=0.5, max_iter=5,
+            weight_decay=0.1,
+        )
+
+        def closure():
+            opt.clear_grad()
+            loss = (p * 0.0).sum()
+            loss.backward()
+            return loss
+
+        before = np.abs(p.numpy()).sum()
+        for _ in range(3):
+            opt.step(closure)
+        after = np.abs(p.numpy()).sum()
+        assert after < before, (before, after)
+        # sign must be preserved (decay pulls toward 0, not through it)
+        assert (np.sign(p.numpy()) == [1.0, -1.0]).all()
+
+    def test_grad_clip_applied(self):
+        """The flat gradient LBFGS differentiates through must be the
+        CLIPPED one (global-norm <= clip_norm)."""
+        p = paddle.to_tensor(np.array([1.0, 1.0], "float32"))
+        p.stop_gradient = False
+        opt = paddle.optimizer.LBFGS(
+            parameters=[p], learning_rate=1.0,
+            grad_clip=nn.ClipGradByGlobalNorm(1.0),
+        )
+        loss = (1000.0 * p).sum()
+        loss.backward()
+        flat = np.asarray(opt._gather_flat_grad())
+        norm = float(np.sqrt((flat ** 2).sum()))
+        np.testing.assert_allclose(norm, 1.0, rtol=1e-5)
+        # direction preserved, magnitude clipped
+        np.testing.assert_allclose(flat, flat[0], rtol=1e-5)

@@ -62,6 +62,49 @@ def test_box_coder_roundtrip():
     np.testing.assert_allclose(dec.numpy(), target.numpy(), atol=1e-3)
 
 
+def test_box_coder_decode_axis1_broadcast():
+    """axis=1: prior n decodes target_box[n, :] (pre-r6 the argument was
+    silently ignored, aligning priors with the wrong axis)."""
+    rng = np.random.RandomState(0)
+    prior = rng.rand(3, 4).astype("float32")
+    prior[:, 2:] += 1.0  # positive width/height
+    var = np.ones((3, 4), "float32")
+    deltas = (rng.rand(3, 5, 4).astype("float32") - 0.5) * 0.2
+
+    got = V.box_coder(
+        paddle.to_tensor(prior), paddle.to_tensor(var),
+        paddle.to_tensor(deltas), code_type="decode_center_size", axis=1,
+    ).numpy()
+    assert got.shape == (3, 5, 4)
+    # oracle: decode each row against ITS prior via the (working) 2-D path
+    for n in range(3):
+        row = V.box_coder(
+            paddle.to_tensor(np.repeat(prior[n:n + 1], 5, axis=0)),
+            paddle.to_tensor(np.repeat(var[n:n + 1], 5, axis=0)),
+            paddle.to_tensor(deltas[n]),
+            code_type="decode_center_size",
+        ).numpy()
+        np.testing.assert_allclose(got[n], row, rtol=1e-5, atol=1e-5)
+    # a 1-D [4] variance broadcasts over every box (review finding: the
+    # axis=1 reshape must not touch it)
+    got_v1 = V.box_coder(
+        paddle.to_tensor(prior), [1.0, 1.0, 1.0, 1.0],
+        paddle.to_tensor(deltas), code_type="decode_center_size", axis=1,
+    ).numpy()
+    np.testing.assert_allclose(got_v1, got, rtol=1e-5)
+    # axis=0 pairs prior k with target_box[:, k] — differs from axis=1
+    got0 = V.box_coder(
+        paddle.to_tensor(rng.rand(5, 4).astype("float32") + [0, 0, 1, 1]),
+        None, paddle.to_tensor(deltas),
+        code_type="decode_center_size", axis=0,
+    ).numpy()
+    assert got0.shape == (3, 5, 4)
+    with pytest.raises(ValueError):
+        V.box_coder(paddle.to_tensor(prior), None,
+                    paddle.to_tensor(deltas),
+                    code_type="decode_center_size", axis=2)
+
+
 def test_deform_conv2d_zero_offset_matches_conv():
     import paddle_tpu.nn.functional as F
 
