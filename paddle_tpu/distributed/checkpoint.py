@@ -12,21 +12,51 @@ each value onto the TARGET state_dict's current mesh/placements (which
 may differ entirely from the saved configuration), i.e. reshard-on-load.
 Under multi-controller, saving goes through each host's addressable
 shards of the same global arrays; format unchanged.
+
+Checkpoint format v2 (docs/resilience.md): every save lands in a fresh
+``ckpt-<n>/`` subdir via write-to-temp + fsync + atomic rename, with a
+crc32 checksum per array recorded in the metadata; the ``latest``
+pointer is updated only after the written files re-read and verify, and
+``load_state_dict`` falls back to the previous verified checkpoint when
+the newest is torn or corrupt. A top-level ``data.npz``/
+``metadata.json`` compatibility view keeps pre-v2 readers working, and
+pre-v2 checkpoint dirs (files directly under ``path``) still load.
 """
 from __future__ import annotations
 
 import json
 import os
+import shutil
+import sys
+import threading
+import uuid
+import zlib
 
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..resilience import faults
 from .dist_tensor import shard_tensor, to_global_array
 from .placement import Partial, Replicate, Shard
 
-__all__ = ["save_state_dict", "load_state_dict", "wait_async_save"]
+__all__ = [
+    "save_state_dict", "load_state_dict", "wait_async_save",
+    "CheckpointCorruptError",
+]
 
 _META_FILE = "metadata.json"
+_DATA_FILE = "data.npz"
+_LATEST_FILE = "latest"
+_CKPT_PREFIX = "ckpt-"
+_FORMAT = 2
+
+# serializes the publish step (dir-index allocation + latest update)
+# across concurrent async writers
+_publish_lock = threading.Lock()
+
+
+class CheckpointCorruptError(RuntimeError):
+    """No verifiable checkpoint could be loaded from the path."""
 
 
 def _placement_to_json(p):
@@ -63,13 +93,189 @@ def wait_async_save():
             raise err[0]
 
 
+def _crc(arr):
+    # crc straight off the array's buffer — no tobytes() copy
+    return zlib.crc32(np.ascontiguousarray(arr).data) & 0xFFFFFFFF
+
+
+def _fsync_file(p):
+    with open(p, "rb") as f:
+        os.fsync(f.fileno())
+
+
+def _fsync_dir(p):
+    fd = os.open(p, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # some filesystems refuse dir fsync; rename is still atomic
+    finally:
+        os.close(fd)
+
+
+def _ckpt_names(path):
+    """Versioned checkpoint dirs under ``path``, newest first."""
+    try:
+        names = [
+            n for n in os.listdir(path)
+            if n.startswith(_CKPT_PREFIX)
+            and n[len(_CKPT_PREFIX):].isdigit()
+            and os.path.isdir(os.path.join(path, n))
+        ]
+    except OSError:
+        return []
+    return sorted(names, key=lambda n: int(n[len(_CKPT_PREFIX):]),
+                  reverse=True)
+
+
+def _verify_dir(d):
+    """Verify one checkpoint dir end to end (json parses, npz opens,
+    every checksummed array matches) and return the metadata payload.
+    Arrays are verified ONE AT A TIME and dropped — a model-scale
+    checkpoint is never fully resident during verification. Raises
+    CheckpointCorruptError on any damage so callers can fall back to an
+    older checkpoint."""
+    try:
+        with open(os.path.join(d, _META_FILE)) as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"{d}: unreadable metadata ({e})"
+        ) from e
+    sums = payload.get("checksums")
+    try:
+        with np.load(os.path.join(d, _DATA_FILE),
+                     allow_pickle=False) as data:
+            files = set(data.files)
+            if sums is not None:
+                for key, want in sums.items():
+                    if key not in files:
+                        raise CheckpointCorruptError(
+                            f"{d}: array {key!r} missing from data file"
+                        )
+                    if _crc(data[key]) != want:
+                        raise CheckpointCorruptError(
+                            f"{d}: checksum mismatch for {key!r}"
+                        )
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:  # BadZipFile / OSError / ValueError / ...
+        raise CheckpointCorruptError(f"{d}: unreadable data ({e})") from e
+    return payload
+
+
+class _FileLock:
+    """fcntl advisory lock serializing publishers ACROSS processes
+    (multi-controller hosts share the checkpoint path); the in-process
+    _publish_lock alone cannot order a read-compare-write of ``latest``
+    between processes."""
+
+    def __init__(self, path):
+        self._path = path
+        self._fd = None
+
+    def __enter__(self):
+        import fcntl
+
+        self._fd = os.open(self._path, os.O_CREAT | os.O_RDWR)
+        try:
+            fcntl.flock(self._fd, fcntl.LOCK_EX)
+        except OSError:
+            pass  # fs without flock: in-process lock still applies
+        return self
+
+    def __exit__(self, *exc):
+        import fcntl
+
+        try:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+        except OSError:
+            pass
+        os.close(self._fd)
+        return False
+
+
+def _publish(path, tmp, keep_last_k):
+    """Atomically promote a verified tmp dir: rename to the next
+    ``ckpt-<n>``, update ``latest``, refresh the v1 compatibility view,
+    rotate old checkpoints. Crash-safe at every boundary — until the
+    ``latest`` replace lands, loads keep resolving the previous
+    checkpoint."""
+    with _publish_lock, _FileLock(os.path.join(path, ".publish.lock")):
+        # index allocation races with OTHER processes saving to the same
+        # path (multi-controller hosts share it): the rename is the
+        # atomic claim, so on collision re-list and take the next index
+        for _ in range(64):
+            names = _ckpt_names(path)
+            n = 1 + (int(names[0][len(_CKPT_PREFIX):]) if names else 0)
+            name = f"{_CKPT_PREFIX}{n:08d}"
+            final = os.path.join(path, name)
+            try:
+                os.rename(tmp, final)
+                break
+            except OSError:
+                if not os.path.isdir(final):
+                    raise  # not an index collision — surface it
+        else:
+            raise OSError(
+                f"could not claim a checkpoint index under {path}"
+            )
+        _fsync_dir(path)
+        # the latest pointer flips only now, after verification — and
+        # only FORWARD: a slow writer in another process must not move
+        # it back onto an older checkpoint
+        cur = 0
+        try:
+            with open(os.path.join(path, _LATEST_FILE)) as f:
+                c = f.read().strip()
+            if c.startswith(_CKPT_PREFIX) and c[len(_CKPT_PREFIX):].isdigit():
+                cur = int(c[len(_CKPT_PREFIX):])
+        except OSError:
+            pass
+        if n > cur:
+            ltmp = os.path.join(path, f".latest-{uuid.uuid4().hex[:8]}")
+            with open(ltmp, "w") as f:
+                f.write(name)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(ltmp, os.path.join(path, _LATEST_FILE))
+            # v1 compatibility view: top-level data.npz/metadata.json
+            # track the newest checkpoint. COPIED, not hardlinked — a
+            # pre-v2 writer rewriting the top-level files in place
+            # (O_TRUNC) must not destroy the versioned data through a
+            # shared inode during a mixed-version rollout
+            for fname in (_DATA_FILE, _META_FILE):
+                vtmp = os.path.join(path, f".view-{uuid.uuid4().hex[:8]}")
+                shutil.copy2(os.path.join(final, fname), vtmp)
+                _fsync_file(vtmp)  # torn view files defeat its purpose
+                os.replace(vtmp, os.path.join(path, fname))
+            _fsync_dir(path)
+        if keep_last_k:
+            for old in _ckpt_names(path)[keep_last_k:]:
+                if old != name:
+                    shutil.rmtree(
+                        os.path.join(path, old), ignore_errors=True
+                    )
+
+
 def save_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, async_save=False):
+                    coordinator_rank=0, async_save=False,
+                    keep_last_k=None):
     """Write each tensor once (global value) + dist metadata
     (ref save_state_dict.py:145). With async_save=True the device->host
     snapshot happens NOW (so training may donate/overwrite buffers
     immediately) and the file IO runs on a background thread; call
-    wait_async_save() as the flush barrier before relying on the files."""
+    wait_async_save() as the flush barrier before relying on the files.
+
+    Format v2: the save is atomic (temp dir + fsync + rename) and
+    verified (per-array crc32 re-read) before the ``latest`` pointer
+    moves; ``keep_last_k`` bounds how many verified checkpoints are
+    retained (None keeps all)."""
+    if keep_last_k is not None and keep_last_k < 1:
+        raise ValueError(
+            f"keep_last_k must be >= 1 or None (keep all), got "
+            f"{keep_last_k}"
+        )
     os.makedirs(path, exist_ok=True)
     meta = {"tensors": {}}
     arrays = {}
@@ -135,17 +341,37 @@ def save_state_dict(state_dict, path, process_group=None,
             "python value"
         )
 
+    ndarrays = {
+        k: v for k, v in arrays.items() if isinstance(v, np.ndarray)
+    }
+
     def _write():
-        np.savez(
-            os.path.join(path, "data.npz"),
-            **{k: v for k, v in arrays.items()
-               if isinstance(v, np.ndarray)},
-        )
-        with open(os.path.join(path, _META_FILE), "w") as f:
-            json.dump(
-                {"meta": meta, "python_values": pyvals}, f,
-                default=_json_default,
-            )
+        # checksums computed HERE so async_save's foreground cost stays
+        # the snapshot copy alone (the crc pass rides the writer thread)
+        checksums = {k: _crc(v) for k, v in ndarrays.items()}
+        tmp = os.path.join(path, f".tmp-{uuid.uuid4().hex[:8]}")
+        os.makedirs(tmp)
+        try:
+            faults.fire("ckpt.write", file=_DATA_FILE, path=path)
+            np.savez(os.path.join(tmp, _DATA_FILE), **ndarrays)
+            faults.fire("ckpt.write", file=_META_FILE, path=path)
+            with open(os.path.join(tmp, _META_FILE), "w") as f:
+                json.dump(
+                    {"meta": meta, "python_values": pyvals,
+                     "format": _FORMAT, "checksums": checksums}, f,
+                    default=_json_default,
+                )
+                f.flush()
+                os.fsync(f.fileno())
+            _fsync_file(os.path.join(tmp, _DATA_FILE))
+            _fsync_dir(tmp)
+            # verify the bytes that actually hit disk BEFORE publishing:
+            # a torn/corrupt write must never become the latest pointer
+            _verify_dir(tmp)
+            _publish(path, tmp, keep_last_k)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
 
     if not async_save:
         _write()
@@ -173,11 +399,19 @@ def load_state_dict(state_dict, path, process_group=None,
     load_state_dict.py + auto_parallel converter semantics).
 
     The target parallel configuration may differ arbitrarily from the one
-    the checkpoint was saved under."""
-    with open(os.path.join(path, _META_FILE)) as f:
-        payload = json.load(f)
+    the checkpoint was saved under.
+
+    Recovery semantics (format v2): the ``latest`` pointer is resolved
+    first; if that checkpoint is torn or corrupt (checksum mismatch,
+    unreadable file), older verified checkpoints are tried newest-first
+    before giving up with CheckpointCorruptError. The state_dict is
+    only mutated after a checkpoint fully verifies (verification
+    streams the arrays, so the checkpoint is never resident twice)."""
+    payload, ckpt_dir = _read_checkpoint(path)
     meta = payload["meta"]["tensors"]
-    data = np.load(os.path.join(path, "data.npz"), allow_pickle=False)
+    # lazy handle: arrays decompress one at a time during the copy loop
+    data = np.load(os.path.join(ckpt_dir, _DATA_FILE),
+                   allow_pickle=False)
 
     missing, unexpected = [], []
     for key, target in state_dict.items():
@@ -218,3 +452,43 @@ def load_state_dict(state_dict, path, process_group=None,
         if key not in state_dict:
             unexpected.append(key)
     return missing, unexpected
+
+
+def _read_checkpoint(path):
+    """Resolve + verify a checkpoint under ``path``: the v2 ``latest``
+    chain with fallback, or the legacy v1 top-level files. Returns
+    (metadata payload, directory holding the verified data file)."""
+    candidates = _ckpt_names(path)
+    latest = None
+    try:
+        with open(os.path.join(path, _LATEST_FILE)) as f:
+            latest = f.read().strip()
+    except OSError:
+        pass
+    if latest and latest in candidates:
+        candidates.remove(latest)
+        candidates.insert(0, latest)
+    if not candidates:
+        # legacy (pre-v2) layout: files directly under path. A missing
+        # checkpoint keeps raising FileNotFoundError (the long-standing
+        # "no checkpoint yet" probe), not CheckpointCorruptError.
+        if not os.path.exists(os.path.join(path, _META_FILE)):
+            raise FileNotFoundError(f"no checkpoint found under {path}")
+        return _verify_dir(path), path
+    errors = []
+    for name in candidates:
+        d = os.path.join(path, name)
+        try:
+            payload = _verify_dir(d)
+        except CheckpointCorruptError as e:
+            errors.append(str(e))
+            continue
+        if errors:
+            sys.stderr.write(
+                "[checkpoint] fell back to %s after: %s\n"
+                % (name, "; ".join(errors))
+            )
+        return payload, d
+    raise CheckpointCorruptError(
+        f"no verifiable checkpoint under {path}: " + "; ".join(errors)
+    )

@@ -111,8 +111,10 @@ def _watched(fn):
 
     @functools.wraps(fn)
     def wrap(*a, **kw):
+        from ..resilience import faults
         from .watchdog import get_comm_watchdog
 
+        faults.fire("collective", op=fn.__name__)
         wd = get_comm_watchdog()
         if wd is None:
             return fn(*a, **kw)

@@ -21,6 +21,7 @@ import socketserver
 import threading
 from hmac import compare_digest as _compare_digest
 
+from ..resilience import RetryPolicy, faults
 from .store import TCPStore
 
 __all__ = [
@@ -148,11 +149,23 @@ def get_all_worker_infos():
     return list((_state.get("infos") or {}).values())
 
 
+# connection establishment is retried under the unified policy; the
+# payload exchange is NOT (a remote call is not idempotent once the
+# payload may have executed)
+def _connect_peer(info, timeout):
+    faults.fire("rpc.call", to=info.name)
+    return socket.create_connection((info.ip, info.port), timeout=timeout)
+
+
 def _call(to, fn, args, kwargs, timeout):
     info = _state["infos"][to] if isinstance(to, str) else to
     payload = pickle.dumps((fn, args or (), kwargs or {}))
-    with socket.create_connection((info.ip, info.port),
-                                  timeout=timeout) as s:
+    # deadline derived from the CALL timeout: retries ride inside the
+    # caller's budget instead of multiplying it
+    policy = RetryPolicy(
+        max_attempts=4, base_delay=0.05, max_delay=1.0, deadline=timeout,
+    )
+    with policy.call(_connect_peer, info, timeout) as s:
         s.sendall(_state["token"]
                   + len(payload).to_bytes(8, "big") + payload)
         buf = _recv_msg(s)

@@ -38,8 +38,16 @@ class ShardDataloader:
     axes replicated. len() follows the inner loader."""
 
     def __init__(self, dataloader, meshes, input_keys=None,
-                 shard_dims=None, is_dataset_splitted=False):
+                 shard_dims=None, is_dataset_splitted=False,
+                 retry_policy=None):
         self._loader = dataloader
+        # per-leaf placement retry under the unified policy. NOTE: the
+        # policy's retry_on decides what counts as transient — jax
+        # backend failures surface as jaxlib XlaRuntimeError (a
+        # RuntimeError), so cover them explicitly, e.g.
+        # RetryPolicy(retry_on=(RuntimeError, OSError)); the default
+        # retry_on (connection/timeout/OS errors) will NOT retry them
+        self._retry = retry_policy
         self._meshes = (
             list(meshes) if isinstance(meshes, (list, tuple)) else [meshes]
         )
@@ -69,9 +77,17 @@ class ShardDataloader:
         return self._meshes[i], self._shard_dims[i]
 
     def _place(self, value, i):
-        mesh, dim = self._mesh_for(i)
+        # containers recurse WITHOUT the retry wrapper: only the leaf
+        # placement is retried, so attempts don't multiply with nesting
+        # depth and healthy siblings are never re-placed
         if isinstance(value, (list, tuple)):
             return type(value)(self._place(v, i) for v in value)
+        if self._retry is not None:
+            return self._retry.call(self._place_once, value, i)
+        return self._place_once(value, i)
+
+    def _place_once(self, value, i):
+        mesh, dim = self._mesh_for(i)
         if not isinstance(value, Tensor):
             return value
         if value.is_dist():
@@ -103,9 +119,11 @@ class ShardDataloader:
 
 
 def shard_dataloader(dataloader, meshes, input_keys=None,
-                     shard_dims=None, is_dataset_splitted=False):
+                     shard_dims=None, is_dataset_splitted=False,
+                     retry_policy=None):
     """ref api.py:3301 — see ShardDataloader."""
     return ShardDataloader(
         dataloader, meshes, input_keys=input_keys, shard_dims=shard_dims,
         is_dataset_splitted=is_dataset_splitted,
+        retry_policy=retry_policy,
     )

@@ -21,8 +21,21 @@ import socket
 import socketserver
 import threading
 import time
+import uuid
+
+from ..resilience import RetryPolicy, faults
 
 __all__ = ["TCPStore"]
+
+
+def _cache_op_result(store, nonce, value):
+    """Remember a mutating op's result under its client nonce (bounded
+    FIFO) so lost-response retries return the original outcome."""
+    if nonce is None:
+        return
+    store._op_results[nonce] = value
+    while len(store._op_results) > 4096:
+        store._op_results.pop(next(iter(store._op_results)))
 
 
 def _send_frame(sock, obj):
@@ -58,23 +71,55 @@ class _Handler(socketserver.BaseRequestHandler):
             key = req.get("key", "")
             with store._cond:
                 if op == "set":
+                    # one server-side op: drop the superseded typed twin
+                    # and write the new entry under the same lock, so a
+                    # concurrent get never observes the key vanish
+                    # between a delete and a set
+                    stale = req.get("stale")
+                    if stale:
+                        store._kv.pop(stale, None)
                     store._kv[key] = req["value"]
                     store._cond.notify_all()
                     _send_frame(self.request, {"ok": True})
                 elif op == "get":
+                    # optional "alt": probe both typed twins of a key in
+                    # ONE op, so a concurrent str<->bytes overwrite can
+                    # never make the key look momentarily absent
+                    hit = None
+                    for k2 in (key, req.get("alt")):
+                        if k2 is not None and k2 in store._kv:
+                            hit = k2
+                            break
                     _send_frame(
                         self.request,
-                        {"ok": key in store._kv,
-                         "value": store._kv.get(key)},
+                        {"ok": hit is not None, "key": hit,
+                         "value": None if hit is None
+                         else store._kv[hit]},
                     )
                 elif op == "add":
-                    cur = int(store._kv.get(key, "0"))
-                    cur += int(req["value"])
-                    store._kv[key] = str(cur)
+                    # nonce dedup makes the increment idempotent under
+                    # client retries: a resend whose first response was
+                    # lost returns the cached result instead of
+                    # double-counting (barriers depend on exact counts)
+                    nonce = req.get("nonce")
+                    if nonce is not None and nonce in store._op_results:
+                        cur = store._op_results[nonce]
+                    else:
+                        cur = int(store._kv.get(key, "0"))
+                        cur += int(req["value"])
+                        store._kv[key] = str(cur)
+                        _cache_op_result(store, nonce, cur)
                     store._cond.notify_all()
                     _send_frame(self.request, {"ok": True, "value": cur})
                 elif op == "delete":
-                    existed = store._kv.pop(key, None) is not None
+                    # same dedup: a retried delete whose first response
+                    # was lost must still report the TRUE 'existed'
+                    nonce = req.get("nonce")
+                    if nonce is not None and nonce in store._op_results:
+                        existed = store._op_results[nonce]
+                    else:
+                        existed = store._kv.pop(key, None) is not None
+                        _cache_op_result(store, nonce, existed)
                     store._cond.notify_all()
                     _send_frame(self.request, {"ok": existed})
                 elif op == "list":
@@ -105,9 +150,18 @@ class TCPStore:
     """
 
     def __init__(self, host, port, is_master=False, timeout=30.0,
-                 world_size=None):
+                 world_size=None, retry_policy=None):
         self.timeout = float(timeout)
+        # the unified coordination-plane retry loop (resilience.retry);
+        # covers dropped RPCs and slow-starting masters. The deadline
+        # bounds TOTAL retry time per op by the store timeout, so a
+        # flapping master cannot stretch one op to attempts x timeout.
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=4, base_delay=0.05, max_delay=1.0,
+            deadline=self.timeout,
+        )
         self._kv = {}
+        self._op_results = {}  # op-nonce -> result (retry dedup)
         self._cond = threading.Condition()
         self._lock = threading.Lock()  # serializes the client socket
         self._server = None
@@ -121,46 +175,73 @@ class TCPStore:
         self._addr = (host, port)
         self._sock = self._connect()
 
-    def _connect(self):
-        deadline = time.time() + self.timeout
-        last = None
-        while time.time() < deadline:
-            try:
-                s = socket.create_connection(self._addr, timeout=5)
-                s.settimeout(self.timeout)
-                return s
-            except OSError as e:
-                last = e
-                time.sleep(0.1)
-        raise TimeoutError(
-            f"cannot reach TCPStore at {self._addr}: {last}"
-        )
+    def _connect(self, budget=None):
+        """(Re)connect within ``budget`` seconds (default: the store
+        timeout) — a slow-starting master is waited out, but never past
+        the budget the caller has left."""
+        budget = self.timeout if budget is None else max(0.05, budget)
 
-    def _rpc(self, op, key="", value=None):
+        def attempt():
+            faults.fire("store.connect", addr=self._addr)
+            s = socket.create_connection(
+                self._addr, timeout=min(5, budget)
+            )
+            s.settimeout(self.timeout)
+            return s
+
+        policy = RetryPolicy(
+            max_attempts=None, base_delay=0.1, max_delay=0.5,
+            deadline=budget,
+        )
+        try:
+            return policy.call(attempt)
+        except OSError as e:
+            raise TimeoutError(
+                f"cannot reach TCPStore at {self._addr}: {e}"
+            ) from e
+
+    def _rpc(self, op, key="", value=None, **extra):
+        frame = {"op": op, "key": key, "value": value, **extra}
+
+        def attempt():
+            faults.fire("store.rpc", op=op, key=key)
+            _send_frame(self._sock, frame)
+            resp = _recv_frame(self._sock)
+            if resp is None:
+                # server closed mid-exchange: surface as retryable
+                raise ConnectionError(
+                    "TCPStore server closed the connection"
+                )
+            return resp
+
+        start = time.monotonic()
+
+        def reconnect(exc, attempt_no):
+            # a long-lived connection can be dropped under load (the
+            # reference store client reconnects the same way): fresh
+            # socket before the next try, within the op's REMAINING
+            # budget so one op never stretches past ~self.timeout
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            remaining = max(
+                0.05, self.timeout - (time.monotonic() - start)
+            )
+            self._sock = self._connect(remaining)
+            self._sock.settimeout(remaining)
+
         with self._lock:
             try:
-                _send_frame(
-                    self._sock, {"op": op, "key": key, "value": value}
-                )
-                resp = _recv_frame(self._sock)
-            except OSError:
-                resp = None
-            if resp is None:
-                # a long-lived connection can be dropped under load (the
-                # reference store client reconnects the same way); retry
-                # once on a fresh socket before giving up
+                return self.retry_policy.call(attempt, on_retry=reconnect)
+            finally:
+                # a late-in-budget reconnect shrank the socket timeout
+                # to the op's remaining budget; restore the store-wide
+                # recv window for the NEXT op on this long-lived socket
                 try:
-                    self._sock.close()
+                    self._sock.settimeout(self.timeout)
                 except OSError:
                     pass
-                self._sock = self._connect()
-                _send_frame(
-                    self._sock, {"op": op, "key": key, "value": value}
-                )
-                resp = _recv_frame(self._sock)
-        if resp is None:
-            raise ConnectionError("TCPStore server closed the connection")
-        return resp
 
     # -- reference Store API ----------------------------------------------
     def set(self, key: str, value):
@@ -171,44 +252,58 @@ class TCPStore:
             value = str(value)
             key_t, stale = "s:" + key, "b:" + key
         # an overwrite that changes str<->bytes must not leave the
-        # superseded typed entry behind (get() probes "s:" first)
-        self._rpc("delete", stale)
-        self._rpc("set", key_t, value)
+        # superseded typed entry behind (get() probes "s:" first); the
+        # server drops the stale twin and writes the new entry as ONE
+        # op, so a concurrent get never sees the key vanish
+        self._rpc("set", key_t, value, stale=stale)
+
+    def _deadline(self, timeout):
+        # explicit timeout=0 means immediate expiry, not the default
+        return time.time() + (
+            self.timeout if timeout is None else float(timeout)
+        )
 
     def get(self, key: str, wait=True, timeout=None):
         """Blocking get (the reference's wait-then-get contract).
         timeout overrides the store-wide default for this call (e.g.
-        the elastic launcher waits out the epoch-0 join window)."""
-        deadline = time.time() + (timeout or self.timeout)
+        the elastic launcher waits out the epoch-0 join window);
+        timeout=0 probes once and expires immediately."""
+        deadline = self._deadline(timeout)
         while True:
-            for kt in ("s:" + key, "b:" + key):
-                resp = self._rpc("get", kt)
-                if resp.get("ok"):
-                    v = resp["value"]
-                    if kt.startswith("b:"):
-                        return base64.b64decode(v)
-                    return v
+            # both typed twins probed in one server-side op (atomic
+            # against concurrent type-changing overwrites)
+            resp = self._rpc("get", "s:" + key, alt="b:" + key)
+            if resp.get("ok"):
+                v = resp["value"]
+                if (resp.get("key") or "").startswith("b:"):
+                    return base64.b64decode(v)
+                return v
             if not wait:
                 return None
-            if time.time() > deadline:
+            if time.time() >= deadline:
                 raise TimeoutError(f"TCPStore.get({key!r}) timed out")
             time.sleep(0.05)
 
     def wait(self, keys, timeout=None):
-        deadline = time.time() + (timeout or self.timeout)
+        deadline = self._deadline(timeout)
         for k in keys if isinstance(keys, (list, tuple)) else [keys]:
             while self.get(k, wait=False) is None:
-                if time.time() > deadline:
+                if time.time() >= deadline:
                     raise TimeoutError(f"TCPStore.wait({k!r}) timed out")
                 time.sleep(0.05)
 
     def add(self, key: str, amount: int = 1) -> int:
-        return int(self._rpc("add", "s:" + key, str(amount))["value"])
+        # the nonce keeps retried increments exactly-once server-side
+        return int(self._rpc(
+            "add", "s:" + key, str(amount), nonce=uuid.uuid4().hex,
+        )["value"])
 
     def delete_key(self, key: str) -> bool:
         ok = False
         for kt in ("s:" + key, "b:" + key):
-            ok = self._rpc("delete", kt)["ok"] or ok
+            ok = self._rpc(
+                "delete", kt, nonce=uuid.uuid4().hex
+            )["ok"] or ok
         return ok
 
     def list_keys(self, prefix: str = ""):
@@ -220,12 +315,12 @@ class TCPStore:
         """Counter barrier (the reference implements barriers over the
         store the same way: add + wait for the full count)."""
         n = self.add(f"__barrier/{name}", 1)
-        deadline = time.time() + (timeout or self.timeout)
+        deadline = self._deadline(timeout)
         while n < world_size:
             n = int(self.get(f"__barrier/{name}") or 0)
             if n >= world_size:
                 break
-            if time.time() > deadline:
+            if time.time() >= deadline:
                 raise TimeoutError(
                     f"barrier {name!r}: {n}/{world_size} arrived"
                 )

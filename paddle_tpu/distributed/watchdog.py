@@ -50,6 +50,7 @@ class CommWatchdog:
         self._stop = threading.Event()
         self.fired = None      # (tag, why) after a trip
         self._seen_abort = None  # last ABORT_KEY value acted on
+        self._probes = {}      # name -> callable() -> dict, dumped on trip
         if store is not None:
             try:  # a fresh watchdog must not trip on a PREVIOUS abort
                 store.delete_key(ABORT_KEY)
@@ -84,6 +85,16 @@ class CommWatchdog:
 
     def watch(self, tag, timeout=None):
         return self._Scope(self, tag, timeout or self.timeout)
+
+    def register_probe(self, name, fn):
+        """Attach a health probe (e.g. ``serving.Engine.health``); its
+        snapshot is dumped next to the thread stacks when the watchdog
+        trips, so a hang report carries subsystem state. Probes are
+        only INVOKED at trip time (they may touch wedged subsystems);
+        one that returns None — its target was garbage-collected — is
+        pruned by the trip dump. Register through a weakref closure so
+        a dead target costs a dict entry, not its object graph."""
+        self._probes[name] = fn
 
     def _register(self, tag, timeout):
         with self._lock:
@@ -145,6 +156,15 @@ class CommWatchdog:
         for tid, frame in sys._current_frames().items():
             sys.stderr.write(f"--- thread {tid} ---\n")
             sys.stderr.write("".join(traceback.format_stack(frame)))
+        for name, probe in list(self._probes.items()):
+            try:
+                snap = probe()
+                if snap is None:  # probe target was garbage-collected
+                    self._probes.pop(name, None)
+                    continue
+                sys.stderr.write(f"--- probe {name}: {snap!r}\n")
+            except Exception as e:  # a broken probe must not mask the trip
+                sys.stderr.write(f"--- probe {name} failed: {e!r}\n")
         if self.store is not None and why == "local timeout":
             try:  # propagate so peers abort instead of waiting
                 # timestamp nonce: a repeat abort of the same tag must

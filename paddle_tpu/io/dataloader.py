@@ -23,11 +23,13 @@ from __future__ import annotations
 import multiprocessing as _mp
 import queue
 import threading
+import time
 import traceback
 
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..resilience import faults
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
@@ -243,6 +245,11 @@ def _mp_worker_loop(dataset, collate_fn, index_q, result_q, worker_id,
                 return
             bidx, indices = job
             try:
+                # fault site inherited through fork: schedules active in
+                # the parent reach the worker (docs/resilience.md)
+                faults.fire(
+                    "dataloader.worker", worker_id=worker_id, batch=bidx,
+                )
                 batch = collate_fn([dataset[i] for i in indices])
                 meta, shms = _shm_pack(batch)
                 result_q.put((bidx, "__ok__", meta))
@@ -263,6 +270,9 @@ class _MPLoaderIter:
     def __init__(self, loader):
         ctx = _mp.get_context("fork")
         self._n = loader.num_workers
+        # shutdown grace before terminate->kill escalation; a user
+        # DataLoader(timeout=...) bounds it (0 keeps the 5 s default)
+        self._grace = float(getattr(loader, "timeout", 0) or 5.0)
         self._index_q = ctx.Queue()
         self._result_q = ctx.Queue()
         self._batches = list(enumerate(loader.batch_sampler))
@@ -336,11 +346,23 @@ class _MPLoaderIter:
         finally:
             self.shutdown()
 
-    def shutdown(self):
+    def shutdown(self, grace=None):
+        """Stop workers with escalation: SIGTERM, wait out the grace
+        period, then SIGKILL stragglers — a worker hung in native code
+        (or ignoring SIGTERM) must not leak past close. Raises if any
+        child survives SIGKILL (only possible for unkillable D-state
+        processes, which the caller must know about)."""
+        grace = self._grace if grace is None else float(grace)
         for p in self._procs:
             if p.is_alive():
                 p.terminate()
+        deadline = time.monotonic() + grace
         for p in self._procs:
+            p.join(timeout=max(0.0, deadline - time.monotonic()))
+        stragglers = [p for p in self._procs if p.is_alive()]
+        for p in stragglers:
+            p.kill()
+        for p in stragglers:
             p.join(timeout=5)
         # unlink any unconsumed shm blocks
         try:
@@ -350,6 +372,11 @@ class _MPLoaderIter:
                     _shm_unpack(payload)
         except queue.Empty:
             pass
+        leaked = [p.pid for p in self._procs if p.is_alive()]
+        if leaked:
+            raise RuntimeError(
+                f"DataLoader workers survived SIGKILL: pids {leaked}"
+            )
 
 
 class DataLoader:
@@ -368,6 +395,7 @@ class DataLoader:
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = max(0, int(num_workers))
+        self.timeout = float(timeout or 0)
         self.prefetch_factor = max(1, int(prefetch_factor))
         self.use_shared_memory = bool(use_shared_memory)
         self.worker_init_fn = worker_init_fn

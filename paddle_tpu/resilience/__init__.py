@@ -1,0 +1,21 @@
+"""paddle_tpu.resilience — failure is a first-class event.
+
+Three pieces shared by every subsystem (see docs/resilience.md):
+
+  * ``faults`` — a deterministic fault-injection registry. Product code
+    declares named sites (``faults.fire("store.rpc", op=...)``); tests
+    activate seeded schedules via ``faults.inject({...})`` and assert
+    the recovery path actually runs.
+  * ``RetryPolicy`` — the unified exponential-backoff/jitter/deadline
+    retry loop used by TCPStore, distributed.rpc, and shard_loader.
+  * checkpoint hardening, serving degradation, and dataloader shutdown
+    escalation live in their own subsystems but are built on the two
+    primitives above.
+"""
+from . import faults
+from .faults import FaultInjector, FaultSpec
+from .retry import RetryPolicy, retry_call
+
+__all__ = [
+    "faults", "FaultSpec", "FaultInjector", "RetryPolicy", "retry_call",
+]

@@ -17,13 +17,13 @@ docs/serving.md for the architecture walkthrough.
                            serving.SamplingParams(max_new_tokens=64))
 """
 from .adapter import LlamaServingAdapter, build_adapter
-from .engine import Engine, EngineConfig
+from .engine import Engine, EngineConfig, EngineOverloadedError
 from .kv_cache import BlockManager, KVPool
 from .metrics import EngineMetrics
 from .request import Request, RequestOutput, RequestState, SamplingParams
 
 __all__ = [
-    "Engine", "EngineConfig", "SamplingParams", "Request", "RequestOutput",
-    "RequestState", "BlockManager", "KVPool", "EngineMetrics",
-    "LlamaServingAdapter", "build_adapter",
+    "Engine", "EngineConfig", "EngineOverloadedError", "SamplingParams",
+    "Request", "RequestOutput", "RequestState", "BlockManager", "KVPool",
+    "EngineMetrics", "LlamaServingAdapter", "build_adapter",
 ]

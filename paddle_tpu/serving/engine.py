@@ -38,20 +38,29 @@ guarantee.
 from __future__ import annotations
 
 import collections
+import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..distributed.watchdog import CommTimeoutError, get_comm_watchdog
 from ..jit.bucketing import next_bucket
 from ..profiler import RecordEvent
+from ..resilience import faults
 from .adapter import build_adapter
 from .kv_cache import BlockManager, KVPool
 from .metrics import EngineMetrics
 from .request import Request, RequestOutput, RequestState, SamplingParams
 from .sampler import pack_sampling_params, sample_tokens
 
-__all__ = ["Engine", "EngineConfig"]
+__all__ = ["Engine", "EngineConfig", "EngineOverloadedError"]
+
+
+class EngineOverloadedError(RuntimeError):
+    """add_request rejected under KV pressure (load shedding): the
+    caller should back off / route elsewhere rather than deepen an
+    already-saturated queue."""
 
 
 def _default_buckets(max_model_len):
@@ -68,7 +77,7 @@ def _default_buckets(max_model_len):
 class EngineConfig:
     def __init__(self, max_batch_slots=8, max_model_len=2048, page_size=16,
                  num_blocks=None, prefill_buckets=None, max_waiting=None,
-                 seed=0):
+                 seed=0, kv_shed_threshold=None):
         if max_batch_slots < 1:
             raise ValueError("max_batch_slots must be >= 1")
         if page_size < 1 or max_model_len < 2:
@@ -101,6 +110,15 @@ class EngineConfig:
                 f"{max_waiting}"
             )
         self.max_waiting = max_waiting
+        if kv_shed_threshold is not None and not 0.0 < kv_shed_threshold <= 1.0:
+            raise ValueError(
+                f"kv_shed_threshold must be in (0, 1] or None, got "
+                f"{kv_shed_threshold}"
+            )
+        # load shedding: when KV-pool utilization is at/above this
+        # fraction AND the request cannot be admitted immediately,
+        # add_request raises EngineOverloadedError instead of queueing
+        self.kv_shed_threshold = kv_shed_threshold
         self.seed = int(seed)
 
 
@@ -135,6 +153,20 @@ class Engine:
         self._key_counter = 0
         self._base_key = jax.random.PRNGKey(cfg.seed)
         self._build_steps()
+        # observability: a comm watchdog trip dumps this engine's health
+        # snapshot next to the thread stacks. Registered through a
+        # weakref so the watchdog never pins a dead engine (weights +
+        # KV pool) in memory; a collected engine's probe returns None
+        # and is skipped by the dump.
+        wd = get_comm_watchdog()
+        if wd is not None and hasattr(wd, "register_probe"):
+            import weakref
+
+            def _probe(ref=weakref.ref(self)):
+                eng = ref()
+                return None if eng is None else eng.health()
+
+            wd.register_probe(f"serving.engine.{id(self):x}", _probe)
 
     # -- compiled steps ------------------------------------------------------
     def _build_steps(self):
@@ -142,6 +174,9 @@ class Engine:
         # donation keeps the pool single-buffered on TPU; CPU PJRT ignores
         # donation (and warns), so skip it there
         donate = (1, 2) if jax.default_backend() == "tpu" else ()
+        # poison isolation needs to know whether a failed launch may
+        # have consumed the donated pool buffers (see _decode_subset)
+        self._pool_donated = bool(donate)
 
         # ``any_sample`` is STATIC (python bool): an all-greedy batch —
         # the common serving case — compiles a program with no sampling
@@ -209,6 +244,21 @@ class Engine:
                 f"prompt of {len(req.prompt_token_ids)} tokens leaves no "
                 f"room to generate under max_model_len={cfg.max_model_len}"
             )
+        if cfg.kv_shed_threshold is not None:
+            bm = self.block_manager
+            util = bm.utilization()
+            admissible_now = (
+                not self.waiting and None in self.slots
+                and bm.can_allocate(
+                    bm.blocks_needed(len(req.prompt_token_ids) + 1)
+                )
+            )
+            if util >= cfg.kv_shed_threshold and not admissible_now:
+                self.metrics.requests_shed += 1
+                raise EngineOverloadedError(
+                    f"KV pool at {util:.0%} utilization (threshold "
+                    f"{cfg.kv_shed_threshold:.0%}); request shed"
+                )
         self.waiting.append(req)
         self.metrics.requests_received += 1
         return req
@@ -252,17 +302,33 @@ class Engine:
         while pending or self.has_unfinished():
             while pending and (cap is None or len(self.waiting) < cap):
                 p, sp = pending.popleft()
-                reqs.append(self.add_request(p, sp))
+                try:
+                    reqs.append(self.add_request(p, sp))
+                except EngineOverloadedError:
+                    # flow control, not a caller-visible rejection: the
+                    # prompt is resubmitted once the batch drains, so
+                    # undo the shed count the internal retry incurred
+                    self.metrics.requests_shed -= 1
+                    pending.appendleft((p, sp))
+                    break
             for out in self.step():
                 done[out.request_id] = out
         return [done[r.request_id] for r in reqs]
 
     # -- scheduler -----------------------------------------------------------
     def step(self):
-        """One scheduler iteration: admit + prefill joiners, then one
-        decode step over the occupied slots. Returns RequestOutputs for
-        requests that finished during this step."""
+        """One scheduler iteration: expire TTLs, admit + prefill
+        joiners, then one decode step over the occupied slots. Returns
+        RequestOutputs for requests that finished during this step.
+
+        Failure containment: a request whose prefill or decode raises is
+        finished with ``finish_reason="error"`` (the exception recorded
+        on ``RequestOutput.error``) while the engine keeps stepping the
+        remaining requests — one poison request cannot take down the
+        batch. Comm-watchdog aborts are NOT contained: a cluster-level
+        abort must propagate."""
         finished: list = []
+        self._expire(finished)
         self._admit(finished)
         if any(r is not None for r in self.slots):
             self._ensure_capacity()
@@ -274,6 +340,66 @@ class Engine:
         m.cache_utilization = bm.utilization()
         m.pool_high_water = bm.high_water
         return finished
+
+    def health(self):
+        """One-call health snapshot (scrape-endpoint / watchdog probe):
+        ``status`` is "ok", "degraded" (poisoned/expired requests or a
+        tripped comm watchdog), or "overloaded" (admission queue full or
+        KV pressure at the shedding threshold)."""
+        m, bm, cfg = self.metrics, self.block_manager, self.config
+        wd = get_comm_watchdog()
+        util = bm.utilization()
+        queue_full = (
+            cfg.max_waiting is not None
+            and len(self.waiting) >= cfg.max_waiting
+        )
+        shedding = (
+            cfg.kv_shed_threshold is not None
+            and util >= cfg.kv_shed_threshold
+        )
+        status = "ok"
+        if (m.requests_errored or m.requests_timeout
+                or (wd is not None and wd.fired is not None)):
+            status = "degraded"
+        if queue_full or shedding:
+            status = "overloaded"
+        return {
+            "status": status,
+            "queue_depth": len(self.waiting),
+            "num_running": sum(r is not None for r in self.slots),
+            "kv_utilization": util,
+            "requests_errored": m.requests_errored,
+            "requests_timeout": m.requests_timeout,
+            "requests_shed": m.requests_shed,
+            "preemptions": m.preemptions,
+            "last_error": m.last_error,
+            "watchdog": {
+                "enabled": wd is not None,
+                "fired": None if wd is None else wd.fired,
+            },
+        }
+
+    def _expire(self, finished):
+        """Finish requests (queued or running) whose TTL has lapsed with
+        finish_reason="timeout"."""
+        now = time.perf_counter()
+        for req in [r for r in self.waiting if r.expired(now)]:
+            self.waiting.remove(req)
+            self.metrics.requests_timeout += 1
+            self._finish(req, "timeout", finished)
+        for req in list(self.slots):
+            if req is not None and req.expired(now):
+                self.metrics.requests_timeout += 1
+                self._finish(req, "timeout", finished)
+
+    def _poison(self, req, exc, finished):
+        """Contain a per-request failure: record it, finish the request
+        with an error, keep the engine stepping."""
+        req.error = f"{type(exc).__name__}: {exc}"
+        m = self.metrics
+        m.requests_errored += 1
+        m.last_error = f"request {req.request_id}: {req.error}"
+        self._finish(req, "error", finished)
 
     def _admit(self, finished):
         cfg, bm = self.config, self.block_manager
@@ -291,14 +417,34 @@ class Engine:
             req.state = RequestState.RUNNING
             req.admit_seq = self._admit_counter
             self._admit_counter += 1
-            self._prefill(req, tokens)
+            try:
+                self._prefill(req, tokens)
+            except CommTimeoutError:
+                raise  # cluster-level abort, not a poison request
+            except Exception as e:
+                if getattr(e, "_kv_pool_unsafe", False):
+                    raise  # donated pool may be gone (see _prefill)
+                self._poison(req, e, finished)
+                continue
             reason = req.check_stop(cfg.max_model_len)
             if reason:
                 self._finish(req, reason, finished)
 
-    def _prefill(self, req, tokens):
-        import time
+    def _watch(self, tag):
+        """Hung-step detection: launches run under the comm watchdog
+        when one is enabled (serving's analogue of watchdog-tracked
+        collectives)."""
+        wd = get_comm_watchdog()
+        if wd is None:
+            import contextlib
 
+            return contextlib.nullcontext()
+        return wd.watch(tag)
+
+    def _prefill(self, req, tokens):
+        faults.fire(
+            "serving.step", phase="prefill", request_id=req.request_id,
+        )
         cfg = self.config
         bucket = next_bucket(len(tokens), cfg.prefill_buckets)
         ids = np.zeros(bucket, np.int32)
@@ -306,14 +452,22 @@ class Engine:
         table = np.zeros(cfg.pages_per_seq, np.int32)
         table[: len(req.block_ids)] = req.block_ids
         p = req.sampling_params
-        with RecordEvent("serving.prefill"):
-            tok, k, v = self._prefill_jit(
-                self.adapter.weights, self.pool.k, self.pool.v,
-                ids, np.int32(len(tokens)), table,
-                np.float32(p.temperature), np.int32(p.top_k),
-                np.float32(p.top_p), np.bool_(p.do_sample),
-                self._next_key(), bool(p.do_sample),
-            )
+        with RecordEvent("serving.prefill"), self._watch("serving.prefill"):
+            try:
+                tok, k, v = self._prefill_jit(
+                    self.adapter.weights, self.pool.k, self.pool.v,
+                    ids, np.int32(len(tokens)), table,
+                    np.float32(p.temperature), np.int32(p.top_k),
+                    np.float32(p.top_p), np.bool_(p.do_sample),
+                    self._next_key(), bool(p.do_sample),
+                )
+            except Exception as e:
+                # same donated-buffer hazard as decode (_launch_decode):
+                # a dispatched-program failure may have consumed the
+                # donated pool, so containment must not continue over it
+                if self._pool_donated:
+                    e._kv_pool_unsafe = True
+                raise
             tok = int(tok)
         self.pool.rebind(k, v)
         req.num_cached = len(tokens)
@@ -366,34 +520,92 @@ class Engine:
         self.metrics.preemptions += 1
 
     def _decode(self, finished):
+        # one key per scheduler step, shared by isolation re-launches:
+        # greedy rows never consume it, and sampled rows see the same
+        # uniforms whether or not a poison request was carved out
+        key = self._next_key()
+        idxs = [i for i, r in enumerate(self.slots) if r is not None]
+        self._decode_subset(idxs, key, finished)
+
+    def _launch_decode(self, idxs, key):
+        """Run the compiled decode step with only ``idxs`` active.
+        Per-slot outputs are independent (each slot attends to its own
+        pages), so any active-mask subset yields the same tokens for its
+        members as the full batch would — the property the poison-
+        isolation bisection in _decode_subset relies on."""
         cfg = self.config
         n = cfg.max_batch_slots
         tokens = np.zeros(n, np.int32)
         positions = np.zeros(n, np.int32)
         tables = np.zeros((n, cfg.pages_per_seq), np.int32)
         active = np.zeros(n, bool)
-        for i, req in enumerate(self.slots):
-            if req is None:
-                continue
+        for i in idxs:
+            req = self.slots[i]
             tokens[i] = req.last_token
             positions[i] = req.num_cached
             tables[i, : len(req.block_ids)] = req.block_ids
             active[i] = True
         params = pack_sampling_params(self.slots)
-        with RecordEvent("serving.decode"):
-            nxt, k, v = self._decode_jit(
-                self.adapter.weights, self.pool.k, self.pool.v,
-                tokens, positions, tables, active,
-                params["temperature"], params["top_k"], params["top_p"],
-                params["do_sample"], self._next_key(),
-                bool(params["do_sample"].any()),
-            )
+        faults.fire(
+            "serving.step", phase="decode",
+            request_ids=tuple(self.slots[i].request_id for i in idxs),
+        )
+        with RecordEvent("serving.decode"), self._watch("serving.decode"):
+            try:
+                nxt, k, v = self._decode_jit(
+                    self.adapter.weights, self.pool.k, self.pool.v,
+                    tokens, positions, tables, active,
+                    params["temperature"], params["top_k"],
+                    params["top_p"], params["do_sample"], key,
+                    bool(params["do_sample"].any()),
+                )
+            except Exception as e:
+                # a failure from the dispatched program may have
+                # consumed the DONATED pool buffers — re-launching over
+                # them would cascade garbage; mark it so isolation
+                # re-raises instead (host-side failures before dispatch,
+                # e.g. injected faults above, stay containable)
+                if self._pool_donated:
+                    e._kv_pool_unsafe = True
+                raise
             nxt = np.asarray(nxt)
         self.pool.rebind(k, v)
         self.metrics.decode_steps += 1
-        for i, req in enumerate(list(self.slots)):
-            if req is None:
-                continue
+        return nxt
+
+    def _decode_subset(self, idxs, key, finished):
+        """Decode ``idxs`` with poison isolation: on failure, carve the
+        poison request out (by exception attribution or bisection) and
+        finish it with an error while the rest still decode this step."""
+        if not idxs:
+            return
+        try:
+            nxt = self._launch_decode(idxs, key)
+        except CommTimeoutError:
+            raise  # cluster-level abort, not a poison request
+        except Exception as e:
+            if getattr(e, "_kv_pool_unsafe", False):
+                raise  # donated pool may be gone: containment impossible
+            rid = getattr(e, "request_id", None)
+            hit = [
+                i for i in idxs if self.slots[i].request_id == rid
+            ] if rid is not None else []
+            if hit:
+                # attributed failure: finish the culprit, decode the rest
+                self._poison(self.slots[hit[0]], e, finished)
+                self._decode_subset(
+                    [i for i in idxs if i != hit[0]], key, finished
+                )
+            elif len(idxs) == 1:
+                self._poison(self.slots[idxs[0]], e, finished)
+            else:
+                mid = len(idxs) // 2
+                self._decode_subset(idxs[:mid], key, finished)
+                self._decode_subset(idxs[mid:], key, finished)
+            return
+        cfg = self.config
+        for i in idxs:
+            req = self.slots[i]
             req.num_cached += 1
             tok = int(nxt[i])
             req.output_token_ids.append(tok)
@@ -414,8 +626,6 @@ class Engine:
             req.slot = None
 
     def _finish(self, req, reason, finished):
-        import time
-
         req.finish_reason = reason
         req.state = RequestState.FINISHED
         req.finish_time = time.perf_counter()

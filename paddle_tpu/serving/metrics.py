@@ -22,6 +22,12 @@ class EngineMetrics:
         self.requests_received = 0
         self.requests_finished = 0
         self.preemptions = 0
+        # degradation accounting (resilience: poison isolation, TTL
+        # expiry, KV-pressure load shedding)
+        self.requests_errored = 0
+        self.requests_timeout = 0
+        self.requests_shed = 0
+        self.last_error = None
         # token flow
         self.prefill_tokens = 0
         self.decode_tokens = 0
@@ -60,6 +66,10 @@ class EngineMetrics:
             "requests_received": self.requests_received,
             "requests_finished": self.requests_finished,
             "preemptions": self.preemptions,
+            "requests_errored": self.requests_errored,
+            "requests_timeout": self.requests_timeout,
+            "requests_shed": self.requests_shed,
+            "last_error": self.last_error,
             "queue_depth": self.queue_depth,
             "num_running": self.num_running,
             "prefill_tokens": self.prefill_tokens,

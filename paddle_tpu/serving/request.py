@@ -29,7 +29,8 @@ class SamplingParams:
     ``do_sample``; warps are temperature -> top-k -> top-p)."""
 
     def __init__(self, max_new_tokens=16, do_sample=False, temperature=1.0,
-                 top_k=0, top_p=1.0, eos_token_id=None, stop_token_ids=()):
+                 top_k=0, top_p=1.0, eos_token_id=None, stop_token_ids=(),
+                 ttl_s=None):
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}"
@@ -50,6 +51,11 @@ class SamplingParams:
         self.top_p = float(top_p)
         self.eos_token_id = eos_token_id
         self.stop_token_ids = tuple(int(t) for t in stop_token_ids)
+        if ttl_s is not None and ttl_s < 0:
+            raise ValueError(f"ttl_s must be >= 0 or None, got {ttl_s}")
+        # wall-clock budget from arrival; the engine finishes the request
+        # with finish_reason="timeout" once it expires (queued or running)
+        self.ttl_s = None if ttl_s is None else float(ttl_s)
 
     @property
     def stop_ids(self):
@@ -89,6 +95,7 @@ class Request:
         self.state = RequestState.WAITING
         self.output_token_ids: list = []
         self.finish_reason = None
+        self.error = None         # "ExcType: msg" when finish_reason="error"
         # scheduling fields (engine-owned while RUNNING)
         self.block_ids: list = []
         self.num_cached = 0       # tokens whose KV is in the pool
@@ -99,6 +106,15 @@ class Request:
         self.arrival_time = time.perf_counter()
         self.first_token_time = None
         self.finish_time = None
+        self.deadline = (
+            self.arrival_time + self.sampling_params.ttl_s
+            if self.sampling_params.ttl_s is not None else None
+        )
+
+    def expired(self, now=None):
+        return self.deadline is not None and (
+            now if now is not None else time.perf_counter()
+        ) >= self.deadline
 
     @property
     def num_tokens(self):
@@ -133,6 +149,7 @@ class RequestOutput:
         self.prompt_token_ids = list(request.prompt_token_ids)
         self.token_ids = list(request.output_token_ids)
         self.finish_reason = request.finish_reason
+        self.error = request.error
         self.time_to_first_token = (
             request.first_token_time - request.arrival_time
             if request.first_token_time is not None else None

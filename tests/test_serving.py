@@ -20,10 +20,12 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu import serving
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.resilience import FaultSpec, faults
 from paddle_tpu.serving import (
     BlockManager,
     Engine,
     EngineConfig,
+    EngineOverloadedError,
     SamplingParams,
 )
 
@@ -331,3 +333,181 @@ class TestEngineAPI:
             model, [1, 2, 3, 4], 12
         )
         assert p.metrics()["requests_finished"] == 2
+
+
+def _drain(engine):
+    """Step until idle; {request_id: RequestOutput}."""
+    done, guard = {}, 0
+    while engine.has_unfinished():
+        for out in engine.step():
+            done[out.request_id] = out
+        guard += 1
+        assert guard < 300, "engine failed to drain"
+    return done
+
+
+class TestGracefulDegradation:
+    """Failure containment (resilience PR): poison requests are isolated,
+    TTLs expire to finish_reason="timeout", KV pressure sheds at
+    add_request, and health() reports it all. Reuses the module-scope
+    engine: every test drains completely, so only counters persist."""
+
+    PROMPTS = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [10, 11]]
+
+    def _run(self, engine, poison=None, phase="prefill"):
+        params = SamplingParams(max_new_tokens=4)
+        reqs = [engine.add_request(p, params) for p in self.PROMPTS]
+        if poison is None:
+            return reqs, _drain(engine)
+        rid = reqs[poison].request_id
+        if phase == "prefill":
+            spec = FaultSpec(
+                RuntimeError("bad weights"),
+                when=lambda c: (c.get("phase") == "prefill"
+                                and c.get("request_id") == rid),
+            )
+        else:
+            # batch-level decode failure: unattributed, so the engine
+            # must bisect to find the poison slot
+            spec = FaultSpec(
+                RuntimeError("nan logits"),
+                when=lambda c: (c.get("phase") == "decode"
+                                and rid in c.get("request_ids", ())),
+            )
+        with faults.inject({"serving.step": spec}):
+            return reqs, _drain(engine)
+
+    def test_health_starts_ok(self, small_engine):
+        h = small_engine.health()
+        assert h["status"] == "ok"
+        assert h["queue_depth"] == 0 and h["num_running"] == 0
+        assert h["watchdog"] == {"enabled": False, "fired": None}
+
+    def test_poison_prefill_isolated_bit_identical_rest(
+        self, model, small_engine
+    ):
+        engine = small_engine
+        ref_reqs, ref = self._run(engine)
+        reqs, out = self._run(engine, poison=2, phase="prefill")
+        poisoned = out[reqs[2].request_id]
+        assert poisoned.finish_reason == "error"
+        assert "bad weights" in poisoned.error
+        assert poisoned.token_ids == []
+        # the other requests' greedy outputs are bit-identical to the
+        # uninjected run — one poison request cannot take down the batch
+        for i in (0, 1, 3):
+            assert (out[reqs[i].request_id].token_ids
+                    == ref[ref_reqs[i].request_id].token_ids)
+        assert engine.block_manager.num_used == 0
+        assert engine.metrics.requests_errored == 1
+        assert engine.health()["status"] == "degraded"
+        assert "bad weights" in engine.metrics.last_error
+
+    def test_poison_decode_bisected_out(self, model, small_engine):
+        engine = small_engine
+        before = engine.metrics.requests_errored
+        ref_reqs, ref = self._run(engine)
+        reqs, out = self._run(engine, poison=1, phase="decode")
+        poisoned = out[reqs[1].request_id]
+        assert poisoned.finish_reason == "error"
+        assert "nan logits" in poisoned.error
+        # prefill succeeded, so the poison request kept its first token
+        assert len(poisoned.token_ids) == 1
+        for i in (0, 2, 3):
+            assert (out[reqs[i].request_id].token_ids
+                    == ref[ref_reqs[i].request_id].token_ids)
+        assert engine.block_manager.num_used == 0
+        assert engine.metrics.requests_errored == before + 1
+
+    def test_attributed_decode_failure_skips_bisection(
+        self, model, small_engine
+    ):
+        engine = small_engine
+        params = SamplingParams(max_new_tokens=3)
+        reqs = [engine.add_request(p, params) for p in self.PROMPTS[:3]]
+        rid = reqs[0].request_id
+
+        def attributed(_ctx):
+            e = RuntimeError("lora swap failed")
+            e.request_id = rid
+            raise e
+
+        launches = []
+        spec = FaultSpec(
+            action=attributed,
+            when=lambda c: (c.get("phase") == "decode"
+                            and rid in c.get("request_ids", ())
+                            and not launches.append(len(c["request_ids"]))),
+        )
+        with faults.inject({"serving.step": spec}):
+            out = _drain(engine)
+        assert out[rid].finish_reason == "error"
+        assert all(out[r.request_id].finish_reason == "length"
+                   for r in reqs[1:])
+        # attribution short-circuits: one full-batch launch saw the
+        # poison id, no singleton bisection launches followed
+        assert launches == [3]
+
+    def test_ttl_expires_queued_and_running(self, model, small_engine):
+        engine = small_engine
+        dead = engine.add_request(
+            [1, 2, 3], SamplingParams(max_new_tokens=4, ttl_s=0.0)
+        )
+        live = engine.add_request([4, 5], SamplingParams(max_new_tokens=2))
+        running = engine.add_request(
+            [6, 7], SamplingParams(max_new_tokens=8)
+        )
+        out = {o.request_id: o for o in engine.step()}
+        # dead expired from the queue; others prefilled (live may even
+        # have finished already)
+        assert dead.finish_reason == "timeout"
+        assert dead.state is serving.RequestState.FINISHED
+        # expire a RUNNING request deterministically mid-flight
+        running.deadline = 0.0
+        out.update(_drain(engine))
+        assert out[running.request_id].finish_reason == "timeout"
+        assert 1 <= len(out[running.request_id].token_ids) < 8
+        assert out[live.request_id].finish_reason == "length"
+        assert engine.metrics.requests_timeout >= 2
+        assert engine.block_manager.num_used == 0
+
+    def test_kv_pressure_load_shedding(self, model, small_engine):
+        engine = small_engine
+        engine.config.kv_shed_threshold = 0.01
+        try:
+            params = SamplingParams(max_new_tokens=6)
+            reqs = [
+                engine.add_request(p, params) for p in self.PROMPTS
+            ]
+            engine.step()  # all four admitted: slots full, blocks held
+            with pytest.raises(EngineOverloadedError, match="shed"):
+                engine.add_request([1, 2], params)
+            assert engine.metrics.requests_shed == 1
+            assert engine.health()["status"] == "overloaded"
+            out = _drain(engine)
+            assert len(out) == len(reqs)
+            # pressure released: admission works again
+            ok = engine.add_request([1, 2], params)
+            out = _drain(engine)
+            assert out[ok.request_id].finish_reason == "length"
+        finally:
+            engine.config.kv_shed_threshold = None
+
+    def test_watchdog_probe_and_health_wiring(self, model):
+        from paddle_tpu.distributed.watchdog import (
+            disable_comm_watchdog,
+            enable_comm_watchdog,
+        )
+
+        wd = enable_comm_watchdog(timeout=30)
+        try:
+            eng = Engine(model, EngineConfig(
+                max_batch_slots=1, max_model_len=16, page_size=4,
+            ))
+            assert any(
+                k.startswith("serving.engine") for k in wd._probes
+            )
+            h = eng.health()
+            assert h["watchdog"]["enabled"] and h["status"] == "ok"
+        finally:
+            disable_comm_watchdog()

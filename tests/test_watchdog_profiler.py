@@ -82,6 +82,38 @@ class TestWatchdog:
             peer_store.close()
             master.close()
 
+    def test_abort_propagates_to_next_span(self):
+        """Rank B is IDLE (no active watch) when rank A's expired watch
+        writes __comm_abort__; B's NEXT watched span must pick the abort
+        up and raise promptly instead of waiting out its own deadline."""
+        port = _port()
+        master = TCPStore("127.0.0.1", port, is_master=True, timeout=10)
+        peer_store = TCPStore("127.0.0.1", port, timeout=10)
+        fired_b = []
+        wd_a = CommWatchdog(timeout=0.3, poll_interval=0.05, store=master,
+                            rank=0, on_timeout=lambda t, w: None)
+        wd_b = CommWatchdog(timeout=30, poll_interval=0.05,
+                            store=peer_store, rank=1,
+                            on_timeout=lambda t, w: fired_b.append(w))
+        try:
+            with pytest.raises(CommTimeoutError):
+                with wd_a.watch("all_reduce"):
+                    time.sleep(0.8)   # A hangs and trips; B is idle
+            assert master.get(ABORT_KEY).startswith("rank0")
+            t0 = time.time()
+            with pytest.raises(CommTimeoutError, match="propagated"):
+                with wd_b.watch("next_collective"):
+                    while wd_b.fired is None and time.time() - t0 < 5:
+                        time.sleep(0.05)
+            # raised off the propagated abort, not B's 30 s deadline
+            assert time.time() - t0 < 5
+            assert fired_b and "rank0" in fired_b[0]
+        finally:
+            wd_a.shutdown()
+            wd_b.shutdown()
+            peer_store.close()
+            master.close()
+
     def test_collectives_run_under_enabled_watchdog(self):
         import paddle_tpu.distributed as dist
 
