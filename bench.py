@@ -457,6 +457,45 @@ def bench_resilience(paddle, on_tpu):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_analysis(paddle, on_tpu):
+    """Static-analyzer overhead (analysis row): wall-time of
+    ``analysis.check`` on the serving decode step — the cost of the
+    Engine warmup gate (EngineConfig(analysis_check=...)). Pure host
+    work (trace + passes, nothing executes), so the row is chip-load
+    independent; it is tracked so analyzer regressions show up next to
+    the serving numbers they gate."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import Engine, EngineConfig
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=12, num_attention_heads=16,
+        max_position_embeddings=2048,
+    ) if on_tpu else LlamaConfig.tiny()
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    eng = Engine(model, EngineConfig(
+        max_batch_slots=8 if on_tpu else 2,
+        max_model_len=512 if on_tpu else 32,
+        page_size=16 if on_tpu else 8,
+    ))
+    report = eng.check_decode(mode="error")  # warm (imports, caches)
+    t0 = time.perf_counter()
+    report = eng.check_decode(mode="error")
+    dt_ms = (time.perf_counter() - t0) * 1e3
+    log(f"[analysis] decode-step check: {dt_ms:.0f}ms "
+        f"({len(report.findings)} findings, h={cfg.hidden_size} "
+        f"L={cfg.num_hidden_layers})")
+    print(json.dumps({
+        "metric": "analysis_decode_check_ms",
+        "value": round(dt_ms, 1),
+        "unit": "ms",
+    }))
+    return dt_ms
+
+
 ROWS = {
     "llama": lambda p, tpu, peak: bench_llama(p, tpu, peak),
     "decode": lambda p, tpu, peak: bench_decode(p, tpu),
@@ -465,6 +504,7 @@ ROWS = {
     "resnet": lambda p, tpu, peak: bench_resnet(p, tpu),
     "dit": lambda p, tpu, peak: bench_dit(p, tpu),
     "resilience": lambda p, tpu, peak: bench_resilience(p, tpu),
+    "analysis": lambda p, tpu, peak: bench_analysis(p, tpu),
 }
 
 
@@ -558,8 +598,8 @@ def main():
                     pass
             return r.returncode
 
-        for name in ("decode", "serving", "resilience", "moe", "resnet",
-                     "dit"):
+        for name in ("decode", "serving", "resilience", "analysis",
+                     "moe", "resnet", "dit"):
             try:
                 if name == "moe":
                     # shrink ladder: retry in fresh subprocesses until a

@@ -92,6 +92,7 @@ from . import autograd  # noqa: E402
 from . import framework  # noqa: E402
 from . import device  # noqa: E402
 from . import resilience  # noqa: E402  (fault injection + retry policy)
+from . import analysis  # noqa: E402  (trace-safety linter / jaxpr analyzer)
 from . import distributed  # noqa: E402
 from . import distribution  # noqa: E402
 

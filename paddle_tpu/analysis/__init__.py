@@ -1,0 +1,44 @@
+"""paddle_tpu.analysis — trace-safety linter and jaxpr program analyzer.
+
+The correctness invariants of a TPU-native framework live in the tracing
+layer: one trace per shape signature, a single-compile decode loop, no
+host syncs on the hot path. This package makes them checkable BEFORE
+runtime — the jaxpr-native analogue of the reference's PIR verification
+passes (shape/dtype checks, inplace/aliasing passes).
+
+Two levels:
+
+  * ``analysis.check(fn, *args)`` — trace (never execute) and run
+    pluggable passes over the closed jaxpr: retrace hazards, dtype
+    drift, host-sync points, const bloat, donation misuse, dead outputs.
+  * ``python -m paddle_tpu.analysis --self`` — AST trace-safety lint
+    over the framework's own source (broad excepts, nondeterminism and
+    global mutation reachable from traced regions), enforced as a tier-1
+    CI gate.
+
+Choke points: ``jit.to_static(..., check="warn"|"error")`` analyzes on
+first call per signature; ``serving.Engine.check_decode()`` asserts the
+decode step is free of host-sync/retrace findings (strengthening the
+compile-count probe); ``tests/test_analysis.py::test_self_lint_clean``
+fails CI on new source violations. See docs/analysis.md for the rule
+catalog.
+"""
+from .api import check, check_call, enforce
+from .astlint import lint_paths, lint_source, self_lint
+from .findings import AnalysisError, Finding, Report, Severity
+from .passes import PASSES, register_pass
+
+__all__ = [
+    "check",
+    "check_call",
+    "enforce",
+    "Finding",
+    "Report",
+    "Severity",
+    "AnalysisError",
+    "register_pass",
+    "PASSES",
+    "lint_source",
+    "lint_paths",
+    "self_lint",
+]

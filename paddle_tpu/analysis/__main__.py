@@ -1,0 +1,36 @@
+"""CLI: ``python -m paddle_tpu.analysis --self`` (the CI self-check
+gate) or ``python -m paddle_tpu.analysis path [path ...]`` to lint
+arbitrary files/trees. Exit code 0 iff no findings."""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .astlint import lint_paths, package_root, self_lint
+from .findings import Report
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="trace-safety lint (level-2 AST rules)",
+    )
+    parser.add_argument(
+        "--self", action="store_true", dest="self_check",
+        help="lint the installed paddle_tpu package (the CI gate)",
+    )
+    parser.add_argument("paths", nargs="*", help="files or directories")
+    args = parser.parse_args(argv)
+    if args.self_check:
+        findings = self_lint()
+    elif args.paths:
+        findings = lint_paths(args.paths, base=package_root())
+    else:
+        parser.error("give --self or at least one path")
+    report = Report(findings)
+    print(report.render())
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

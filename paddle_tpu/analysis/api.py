@@ -1,0 +1,108 @@
+"""``analysis.check`` — trace a function and run the analysis passes.
+
+    report = analysis.check(fn, *example_args)
+    for f in report:
+        print(f.render())
+
+``fn`` may be a plain jax-array function, a Tensor-level function, or a
+``jit.to_static`` StaticFunction (parameters/buffers are lifted so they
+do not read as baked constants). Nothing executes: the function is
+traced to a closed jaxpr and the passes inspect it.
+
+``mode`` controls how a CRASHING PASS is handled (the analyzer must
+never take down the caller): "collect" (default) records a
+``pass-crash`` finding, "warn" degrades to ``warnings.warn``, "error"
+raises ``AnalysisError``. ``enforce`` maps a finished report onto the
+same modes for the ``check=`` choke points.
+"""
+from __future__ import annotations
+
+import warnings
+
+from .findings import AnalysisError, Finding, Report, Severity
+from .passes import AnalysisContext, run_passes
+from .trace import trace
+
+__all__ = ["check", "check_call", "enforce"]
+
+
+def check_call(fn, args=(), kwargs=None, *, mode="collect", passes=None,
+               static_argnums=(), donate_argnums=(),
+               const_bloat_bytes=1 << 20):
+    """Option-safe form of :func:`check`: the call's args/kwargs are
+    passed EXPLICITLY, so a user function whose own kwargs are named
+    ``mode``/``passes``/... cannot collide with analyzer options. The
+    ``to_static(check=)`` choke point uses this entry."""
+    if mode not in ("collect", "warn", "error"):
+        raise ValueError(
+            f'mode must be "collect", "warn" or "error", got {mode!r}'
+        )
+    report = Report()
+    try:
+        tr = trace(
+            fn, args, dict(kwargs or {}),
+            static_argnums=static_argnums, donate_argnums=donate_argnums,
+        )
+    except Exception as e:
+        # same degradation contract as a crashing pass: an analyzer
+        # failure (here: the trace itself, beyond the graph-break
+        # family trace() already converts to host-sync findings) must
+        # never take down the caller except under mode="error"
+        if mode == "error":
+            raise AnalysisError(f"analysis trace failed: {e!r}") from e
+        if mode == "warn":
+            warnings.warn(
+                f"analysis trace failed and was skipped: {e!r}",
+                stacklevel=3,
+            )
+        else:
+            report.add(Finding(
+                rule="trace-crash",
+                severity=Severity.WARNING,
+                message=f"analysis trace crashed: {e!r}",
+            ))
+        return report
+    ctx = AnalysisContext(trace=tr, const_bloat_bytes=const_bloat_bytes)
+    report.extend(run_passes(ctx, mode=mode, passes=passes))
+    return report
+
+
+def check(fn, *args, mode="collect", passes=None, static_argnums=(),
+          donate_argnums=(), const_bloat_bytes=1 << 20, **kwargs):
+    """Trace ``fn(*args, **kwargs)`` (no execution) and run the analysis
+    passes; returns a ``Report`` of structured findings.
+
+    static_argnums/donate_argnums: ``jax.jit`` meaning, plain-array
+    functions only (positional args). const_bloat_bytes: threshold for
+    the const-bloat rule. passes: optional iterable of rule names to
+    restrict the run. If the analyzed function takes kwargs named like
+    these options, use :func:`check_call` instead.
+    """
+    return check_call(
+        fn, args, kwargs, mode=mode, passes=passes,
+        static_argnums=static_argnums, donate_argnums=donate_argnums,
+        const_bloat_bytes=const_bloat_bytes,
+    )
+
+
+def enforce(report, mode, what="analysis"):
+    """Apply a ``check="warn"|"error"`` policy to a finished report:
+    ERROR findings raise under "error" and warn under "warn"; WARNING
+    findings warn under both. Returns the report for chaining."""
+    if mode not in ("warn", "error"):
+        raise ValueError(f'check mode must be "warn" or "error", got {mode!r}')
+    errors = report.errors
+    if errors and mode == "error":
+        raise AnalysisError(
+            f"{what}: {len(errors)} blocking finding(s):\n"
+            + "\n".join(f.render() for f in errors),
+            report,
+        )
+    worth_warning = report.at_least(Severity.WARNING)
+    if worth_warning:
+        warnings.warn(
+            f"{what}: {len(worth_warning)} finding(s):\n"
+            + "\n".join(f.render() for f in worth_warning),
+            stacklevel=3,
+        )
+    return report

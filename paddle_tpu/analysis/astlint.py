@@ -1,0 +1,338 @@
+"""Level-2 trace-safety lint: AST rules over the framework's own source.
+
+The jaxpr passes catch hazards in ONE traced program; this linter
+catches the source patterns that produce them, over the whole package,
+without importing or tracing anything — cheap enough to run as a tier-1
+CI gate (``python -m paddle_tpu.analysis --self``).
+
+Rules:
+
+    broad-except       ``except Exception: pass`` (or bare ``except:``)
+                       silently swallowing everything — narrow it to the
+                       expected types or annotate why it must be broad
+    nondet-in-traced   ``time.time()`` / ``np.random.*`` inside a
+                       function reachable from a traced region: the
+                       value is baked at trace time and frozen into the
+                       compiled program
+    global-mutation    ``global`` declaration inside a traced-reachable
+                       function: module state mutated at trace time, not
+                       per execution
+
+"Traced region" is approximated conservatively (precision over recall):
+roots are functions decorated with ``to_static``/``jit``/``jax.jit``/
+``bucketize`` plus every function in ``ops/impl`` and ``kernels`` (pure
+traced op bodies); reachability follows same-module direct calls
+(``name(...)`` to a module function, ``self.name(...)`` to a method of
+the same class).
+
+Allowlist: a violation is suppressed by a comment on the offending line
+(or the line above)::
+
+    except Exception:
+        pass  # analysis: allow(broad-except) reason why this is safe
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .findings import Finding, Severity
+
+__all__ = ["lint_source", "lint_paths", "self_lint", "package_root"]
+
+_ALLOW_RE = re.compile(r"#\s*analysis:\s*allow\(([a-zA-Z0-9_\-, ]+)\)")
+
+# decorator names that mark a function as a trace root
+_ROOT_DECORATORS = {"to_static", "jit", "bucketize", "TrainStep"}
+# package-relative path prefixes whose functions are traced op bodies
+_ROOT_PREFIXES = (
+    os.path.join("ops", "impl") + os.sep,
+    "kernels" + os.sep,
+)
+_TIME_FNS = {"time", "time_ns", "monotonic", "monotonic_ns",
+             "perf_counter", "perf_counter_ns"}
+
+
+def _allowed(lines, lineno, rule, end=None):
+    """Allow-comment on the line, the line above, or (when ``end`` is
+    given) anywhere in the [lineno, end] range — comment blocks between
+    an ``except`` and its ``pass`` count."""
+    for ln in range(lineno - 1, (end or lineno) + 1):
+        if 1 <= ln <= len(lines):
+            m = _ALLOW_RE.search(lines[ln - 1])
+            if m and rule in {r.strip() for r in m.group(1).split(",")}:
+                return True
+    return False
+
+
+def _is_pass_body(body):
+    return all(
+        isinstance(stmt, ast.Pass)
+        or (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is Ellipsis)
+        for stmt in body
+    )
+
+
+def _names_in(node):
+    """Dotted-name heads mentioned anywhere in a decorator expression."""
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.add(sub.attr)
+    return out
+
+
+class _Module:
+    """One parsed file: function table, call graph, import aliases."""
+
+    def __init__(self, tree):
+        self.functions = {}   # qualname -> FunctionDef
+        self.classes = {}     # class name -> {method name -> qualname}
+        self.time_aliases = set()     # names bound to the time module
+        self.np_aliases = set()       # names bound to numpy
+        self.np_random_aliases = set()  # names bound to numpy.random
+        self._collect(tree)
+
+    def _collect(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._imports(node)
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                methods = {}
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        qual = f"{node.name}.{sub.name}"
+                        self.functions[qual] = sub
+                        methods[sub.name] = qual
+                self.classes[node.name] = methods
+
+    def _imports(self, node):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                bound = alias.asname or alias.name.split(".")[0]
+                if alias.name == "time":
+                    self.time_aliases.add(bound)
+                elif alias.name == "numpy":
+                    self.np_aliases.add(bound)
+                elif alias.name == "numpy.random":
+                    # `import numpy.random` binds `numpy`
+                    if alias.asname:
+                        self.np_random_aliases.add(alias.asname)
+                    else:
+                        self.np_aliases.add("numpy")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "numpy":
+                for alias in node.names:
+                    if alias.name == "random":
+                        self.np_random_aliases.add(
+                            alias.asname or alias.name
+                        )
+
+
+def _roots(mod, relpath):
+    roots = set()
+    from_prefix = relpath is not None and relpath.startswith(_ROOT_PREFIXES)
+    for qual, node in mod.functions.items():
+        if from_prefix:
+            roots.add(qual)
+            continue
+        for dec in node.decorator_list:
+            if _names_in(dec) & _ROOT_DECORATORS:
+                roots.add(qual)
+                break
+    return roots
+
+
+def _edges(mod, qual, node):
+    """Same-module call targets of one function (conservative)."""
+    cls = qual.split(".")[0] if "." in qual else None
+    methods = mod.classes.get(cls, {})
+    out = set()
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if isinstance(f, ast.Name) and f.id in mod.functions:
+            out.add(f.id)
+        elif (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self"
+                and f.attr in methods):
+            out.add(methods[f.attr])
+    return out
+
+
+def _reachable(mod, roots):
+    seen = set(roots)
+    frontier = list(roots)
+    while frontier:
+        qual = frontier.pop()
+        node = mod.functions.get(qual)
+        if node is None:
+            continue
+        for nxt in _edges(mod, qual, node):
+            if nxt not in seen:
+                seen.add(nxt)
+                frontier.append(nxt)
+    return seen
+
+
+def _broad_except(tree, lines, filename):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            broad = handler.type is None or (
+                isinstance(handler.type, ast.Name)
+                and handler.type.id in ("Exception", "BaseException")
+            )
+            if not (broad and _is_pass_body(handler.body)):
+                continue
+            if _allowed(lines, handler.lineno, "broad-except",
+                        end=handler.body[-1].lineno):
+                continue
+            yield Finding(
+                rule="broad-except",
+                severity=Severity.WARNING,
+                message=(
+                    "silent `except Exception: pass` swallows every "
+                    "failure (including trace breaks and injected "
+                    "faults); narrow it to the expected exception types "
+                    "or annotate `# analysis: allow(broad-except) "
+                    "<reason>`"
+                ),
+                file=filename,
+                line=handler.lineno,
+            )
+
+
+def _nondet_calls(mod, node):
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        v = f.value
+        # time.time() and friends
+        if (isinstance(v, ast.Name) and v.id in mod.time_aliases
+                and f.attr in _TIME_FNS):
+            yield sub, f"{v.id}.{f.attr}()"
+        # np.random.<anything>(...)
+        elif (isinstance(v, ast.Attribute) and v.attr == "random"
+                and isinstance(v.value, ast.Name)
+                and v.value.id in mod.np_aliases):
+            yield sub, f"{v.value.id}.random.{f.attr}()"
+        # random.<fn>(...) where random came from numpy
+        elif (isinstance(v, ast.Name) and v.id in mod.np_random_aliases):
+            yield sub, f"{v.id}.{f.attr}()"
+
+
+def _traced_rules(mod, relpath, lines, filename):
+    roots = _roots(mod, relpath)
+    if not roots:
+        return
+    for qual in sorted(_reachable(mod, roots)):
+        node = mod.functions.get(qual)
+        if node is None:
+            continue
+        for call, desc in _nondet_calls(mod, node):
+            if _allowed(lines, call.lineno, "nondet-in-traced"):
+                continue
+            yield Finding(
+                rule="nondet-in-traced",
+                severity=Severity.WARNING,
+                message=(
+                    f"{desc} inside `{qual}` (reachable from a traced "
+                    "region): the value is read ONCE at trace time and "
+                    "frozen into the compiled program; thread it in as "
+                    "an argument or use the staged RNG"
+                ),
+                file=filename,
+                line=call.lineno,
+            )
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Global):
+                continue
+            if _allowed(lines, sub.lineno, "global-mutation"):
+                continue
+            names = ", ".join(sub.names)
+            yield Finding(
+                rule="global-mutation",
+                severity=Severity.WARNING,
+                message=(
+                    f"`global {names}` inside `{qual}` (reachable from "
+                    "a traced region): module state mutates at trace "
+                    "time, not per execution — staged reruns will not "
+                    "see or apply the update"
+                ),
+                file=filename,
+                line=sub.lineno,
+            )
+
+
+def lint_source(text, filename="<string>", relpath=None):
+    """Lint one source blob; returns a list of Findings. ``relpath`` is
+    the package-relative path used for path-based trace roots."""
+    try:
+        tree = ast.parse(text, filename=filename)
+    except SyntaxError as e:
+        return [Finding(
+            rule="parse-error",
+            severity=Severity.ERROR,
+            message=f"cannot parse: {e.msg}",
+            file=filename,
+            line=e.lineno,
+        )]
+    lines = text.splitlines()
+    findings = list(_broad_except(tree, lines, filename))
+    mod = _Module(tree)
+    findings.extend(_traced_rules(mod, relpath, lines, filename))
+    findings.sort(key=lambda f: (f.line or 0))
+    return findings
+
+
+def package_root():
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_paths(paths, base=None):
+    """Lint files/directories (``*.py``, recursively)."""
+    findings = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [
+                    d for d in dirnames if d != "__pycache__"
+                ]
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        findings.extend(
+                            _lint_file(os.path.join(dirpath, name), base)
+                        )
+        else:
+            findings.extend(_lint_file(path, base))
+    return findings
+
+
+def _lint_file(path, base):
+    rel = os.path.relpath(path, base) if base else None
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    return lint_source(text, filename=path, relpath=rel)
+
+
+def self_lint():
+    """Lint the installed ``paddle_tpu`` package itself — the CI gate
+    behind ``python -m paddle_tpu.analysis --self``."""
+    root = package_root()
+    return lint_paths([root], base=root)

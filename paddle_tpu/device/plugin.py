@@ -58,6 +58,8 @@ def register_custom_device(name: str, library_path: str,
         try:
             jax.config.update("jax_platforms", None)
         except Exception:
+            # analysis: allow(broad-except) older jax rejects a None
+            # platform list; the plugin is still registered either way
             pass
 
 

@@ -291,8 +291,8 @@ def _terminate(procs, grace=5.0):
             proc.wait()
         try:
             log_f.close()
-        except Exception:
-            pass
+        except OSError:
+            pass  # flush of a torn log pipe; the procs are already down
 
 
 if __name__ == "__main__":

@@ -197,13 +197,13 @@ def shutdown():
     try:
         world = len(_state["infos"])
         _state["store"].barrier("rpc_shutdown", world)
-    except Exception:
-        pass
+    except (OSError, RuntimeError):
+        pass  # peers already gone: shut down our side regardless
     _state["server"].shutdown()
     _state["server"].server_close()
     _state["pool"].shutdown(wait=False)
     try:
         _state["store"].close()
-    except Exception:
-        pass
+    except OSError:
+        pass  # socket already torn down
     _state.clear()

@@ -55,6 +55,9 @@ class CommWatchdog:
             try:  # a fresh watchdog must not trip on a PREVIOUS abort
                 store.delete_key(ABORT_KEY)
             except Exception:
+                # analysis: allow(broad-except) best-effort cleanup on a
+                # user-supplied store: any failure here must not block
+                # watchdog construction
                 pass
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -173,6 +176,9 @@ class CommWatchdog:
                 self._seen_abort = val  # don't re-trip on our own abort
                 self.store.set(ABORT_KEY, val)
             except Exception:
+                # analysis: allow(broad-except) abort propagation is
+                # best-effort over a possibly-wedged store; peers still
+                # time out locally if this write never lands
                 pass
         if self._on_timeout is not None:
             self._on_timeout(tag, why)

@@ -149,7 +149,7 @@ class StaticFunction:
     fwd/bwd partial-program pair (jit/dy2static/partial_program.py).
     """
 
-    def __init__(self, function, layer=None):
+    def __init__(self, function, layer=None, check=None):
         self._function = function
         self._layer = layer
         if layer is not None:
@@ -162,6 +162,36 @@ class StaticFunction:
         self._out_tree = None
         self._nan_nets = {}
         self._cur_nan_key = None
+        if check not in (None, "warn", "error"):
+            raise ValueError(
+                f'check must be None, "warn" or "error", got {check!r}'
+            )
+        self._check = check
+        self._checked_sigs = set()
+
+    def _run_check(self, args, kwargs, sig):
+        """``to_static(check=...)`` choke point: on the first call per
+        input signature (``sig`` — the same key the nan net uses), run
+        the static analyzer over the function (trace only, nothing
+        executes) and warn/raise per mode BEFORE the real staging trace
+        — so e.g. a host-sync lands as a structured AnalysisError with
+        provenance instead of a raw TracerBoolConversionError."""
+        if sig in self._checked_sigs:
+            return
+        from .. import analysis
+
+        # check_call, not check: user kwargs named mode/passes/... must
+        # reach the analyzed function, not the analyzer's options
+        report = analysis.check_call(self, args, kwargs, mode=self._check)
+        analysis.enforce(
+            report, self._check,
+            what=f"to_static(check={self._check!r}) analysis of "
+            f"{getattr(self._function, '__name__', self._function)!r}",
+        )
+        # marked checked only on a pass: a blocking finding re-raises
+        # (as a structured AnalysisError) on every call, instead of
+        # degrading to the raw tracer error on the second one
+        self._checked_sigs.add(sig)
 
     def _build_core(self):
         fn = self._function
@@ -234,13 +264,16 @@ class StaticFunction:
         if self._core is None:
             self._core = self._build_core()
         in_arrays, in_meta = self._split_inputs(args, kwargs)
-        self._cur_nan_key = (
+        sig = (
             in_meta,
             tuple(
                 (tuple(a.shape), str(a.dtype))
                 for a in in_arrays if hasattr(a, "shape")
             ),
         )
+        if self._check is not None:
+            self._run_check(args, kwargs, sig)
+        self._cur_nan_key = sig
         buf_arrays = [b._data for b in self._buffers]
         key = random_mod.default_generator.split_key()
         params = self._params
@@ -304,18 +337,29 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
+              backend=None, full_graph=True, check=None, **kwargs):
     """Decorator/wrapper staging a function or Layer (ref: jit/api.py:197).
 
     ``input_spec``/``build_strategy``/``backend`` are accepted for API
     parity; shapes are taken from the first call (jax.jit caches per
     shape signature, recompiling per new signature — the bucketing
     policy replacing the reference's symbolic-shape DimExpr machinery).
+
+    ``check="warn"|"error"`` runs the static analyzer
+    (``paddle_tpu.analysis``) over the function on the first call per
+    input signature: host syncs, retrace hazards, dtype drift etc.
+    surface as structured findings (warned or raised) before staging.
     """
+    if check is not None and not full_graph:
+        raise ValueError(
+            "check= requires full_graph=True (the graph-break fallback "
+            "intentionally tolerates host syncs)"
+        )
+
     def _wrap(obj):
         if isinstance(obj, Layer):
             if full_graph:
-                sf = StaticFunction(obj.forward, layer=obj)
+                sf = StaticFunction(obj.forward, layer=obj, check=check)
             else:
                 from .graph_break import GraphBreakFunction
 
@@ -328,7 +372,7 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             from .graph_break import GraphBreakFunction
 
             return GraphBreakFunction(obj)
-        return StaticFunction(obj)
+        return StaticFunction(obj, check=check)
 
     if function is not None:
         return _wrap(function)
@@ -637,12 +681,11 @@ class TrainStep:
             tuple(grad_sharding(p) for p in self._params)
             if grad_sharding is not None else None
         )
+        from ..optimizer.optimizer import _found_inf_operand
+
         lr = jnp.float32(opt.get_lr())
         t = jnp.float32(opt._global_step + 1)
-        found_inf = (
-            opt._found_inf if opt._found_inf is not None
-            else jnp.asarray(False)
-        )
+        found_inf = _found_inf_operand(opt)
         key = random_mod.default_generator.split_key()
         tree_args = (_to_arrays(args), _to_arrays(kwargs))
         self._cur_nan_key = (

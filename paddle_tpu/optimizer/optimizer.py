@@ -39,8 +39,8 @@ def _malloc_trim():
         import ctypes
 
         ctypes.CDLL("libc.so.6").malloc_trim(0)
-    except Exception:
-        pass
+    except (OSError, AttributeError):
+        pass  # non-glibc libc (musl/macOS): no malloc_trim to call
 
 
 class _PAttr(NamedTuple):
@@ -54,6 +54,14 @@ class _PAttr(NamedTuple):
     multi_precision: bool
     decoupled_decay: float = 0.0  # AdamW-style p *= (1 - lr*coeff)
     lr_ratio: float = 1.0  # AdamW lr_ratio(param) hook
+
+
+def _found_inf_operand(opt):
+    """GradScaler found_inf as a staged scalar operand. The dtype is
+    pinned: a bare ``jnp.asarray(False)`` yields a weakly-typed scalar
+    that can silently promote downstream (analysis rule dtype-drift)."""
+    fi = opt._found_inf
+    return fi if fi is not None else jnp.asarray(False, dtype=jnp.bool_)
 
 
 def _normalize_weight_decay(wd):
@@ -397,11 +405,7 @@ class Optimizer:
 
         lr = jnp.float32(self.get_lr())
         t = jnp.float32(self._global_step + 1)
-        found_inf = (
-            self._found_inf
-            if self._found_inf is not None
-            else jnp.asarray(False)
-        )
+        found_inf = _found_inf_operand(self)  # dtype-pinned bool
 
         grad_sharding = getattr(self, "_grad_sharding_for", None)
         if grad_sharding is not None:

@@ -29,6 +29,10 @@ docs/resilience.md):
                        phase=, request_id=/request_ids=)
     dataloader.worker  one process-worker job (context: worker_id=)
     collective         one watched eager collective (context: op=)
+    analysis.pass      one static-analyzer pass invocation (context:
+                       rule=) — lets tests assert a crashing analyzer
+                       degrades (check="warn") instead of killing the
+                       caller
 
 Schedules are deterministic: occurrence-number triggers (``at``/
 ``every``) count ``fire()`` calls per site per injector, and the

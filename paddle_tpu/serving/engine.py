@@ -77,7 +77,7 @@ def _default_buckets(max_model_len):
 class EngineConfig:
     def __init__(self, max_batch_slots=8, max_model_len=2048, page_size=16,
                  num_blocks=None, prefill_buckets=None, max_waiting=None,
-                 seed=0, kv_shed_threshold=None):
+                 seed=0, kv_shed_threshold=None, analysis_check=None):
         if max_batch_slots < 1:
             raise ValueError("max_batch_slots must be >= 1")
         if page_size < 1 or max_model_len < 2:
@@ -119,6 +119,16 @@ class EngineConfig:
         # fraction AND the request cannot be admitted immediately,
         # add_request raises EngineOverloadedError instead of queueing
         self.kv_shed_threshold = kv_shed_threshold
+        if analysis_check not in (None, "warn", "error"):
+            raise ValueError(
+                'analysis_check must be None, "warn" or "error", got '
+                f"{analysis_check!r}"
+            )
+        # warmup gate: statically analyze the decode step at engine
+        # build (paddle_tpu.analysis) and warn/raise on host-sync or
+        # retrace findings — the static strengthening of the
+        # compile-count probe
+        self.analysis_check = analysis_check
         self.seed = int(seed)
 
 
@@ -218,12 +228,92 @@ class Engine:
             )
             return nxt, kp, vp
 
+        self._prefill_fn = prefill_fn   # unjitted: analysis traces these
+        self._decode_fn = decode_fn
         self._prefill_jit = jax.jit(
             prefill_fn, donate_argnums=donate, static_argnums=(11,)
         )
         self._decode_jit = jax.jit(
             decode_fn, donate_argnums=donate, static_argnums=(12,)
         )
+        if self.config.analysis_check is not None:
+            self.check_decode(self.config.analysis_check)
+
+    def check_decode(self, mode="error"):
+        """Statically analyze the decode step (``paddle_tpu.analysis``)
+        over representative inputs and assert it is free of host-sync
+        and retrace findings — the serving-loop invariant behind the
+        single-compile guarantee, checked WITHOUT executing anything.
+        Strengthens the compile-count probe: the probe detects a
+        retrace after it happened, this gate rejects the hazard before
+        warmup. Returns the full analysis Report.
+
+        ``mode``: "error" raises ``analysis.AnalysisError`` on a
+        violation (and on an analyzer-pass failure); "warn" degrades
+        everything to warnings — analysis never takes down serving.
+        """
+        from .. import analysis
+
+        if mode not in ("warn", "error"):
+            raise ValueError(
+                f'check_decode mode must be "warn" or "error", got '
+                f"{mode!r}"
+            )
+        cfg = self.config
+        n = cfg.max_batch_slots
+        params = pack_sampling_params(self.slots)
+        m = self.metrics
+        saved = (m.prefill_compiles, m.decode_compiles)
+        report = analysis.Report()
+        try:
+            # trace-only: restore the traced-body compile probes after,
+            # so an analysis trace never reads as a real (re)compile
+            # (the harness isolates the pjit cache, so the real warmup
+            # launch still traces — and counts — normally). BOTH static
+            # program variants are gated: greedy-only (any_sample=False)
+            # and mixed-sampling (True) — a hazard inside the sampling
+            # warp must not wait for the first do_sample request.
+            seen = set()
+            for any_sample in (False, True):
+                do_sample = (
+                    np.ones(n, bool) if any_sample
+                    else params["do_sample"]
+                )
+                variant = analysis.check(
+                    self._decode_fn,
+                    self.adapter.weights, self.pool.k, self.pool.v,
+                    np.zeros(n, np.int32), np.zeros(n, np.int32),
+                    np.zeros((n, cfg.pages_per_seq), np.int32),
+                    np.zeros(n, bool),
+                    params["temperature"], params["top_k"],
+                    params["top_p"], do_sample, self._base_key,
+                    any_sample,
+                    static_argnums=(12,),
+                    donate_argnums=(1, 2) if self._pool_donated else (),
+                    mode=mode,
+                )
+                for f in variant.findings:
+                    key = (f.rule, f.file, f.line, f.message)
+                    if key not in seen:  # shared-path findings once
+                        seen.add(key)
+                        report.add(f)
+        finally:
+            m.prefill_compiles, m.decode_compiles = saved
+        blocking = report.by_rule("host-sync") + report.by_rule(
+            "retrace-hazard"
+        )
+        if blocking:
+            msg = (
+                "serving decode step failed static analysis (the "
+                "single-compile decode invariant):\n"
+                + "\n".join(f.render() for f in blocking)
+            )
+            if mode == "error":
+                raise analysis.AnalysisError(msg, report)
+            import warnings
+
+            warnings.warn(msg, stacklevel=2)
+        return report
 
     def _next_key(self):
         self._key_counter += 1
