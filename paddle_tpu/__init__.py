@@ -91,6 +91,7 @@ from . import inference  # noqa: E402
 from . import autograd  # noqa: E402
 from . import framework  # noqa: E402
 from . import device  # noqa: E402
+from . import observability  # noqa: E402  (metrics/spans/flight recorder)
 from . import resilience  # noqa: E402  (fault injection + retry policy)
 from . import analysis  # noqa: E402  (trace-safety linter / jaxpr analyzer)
 from . import distributed  # noqa: E402

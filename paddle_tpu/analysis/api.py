@@ -39,10 +39,18 @@ def check_call(fn, args=(), kwargs=None, *, mode="collect", passes=None,
         )
     report = Report()
     try:
-        tr = trace(
-            fn, args, dict(kwargs or {}),
-            static_argnums=static_argnums, donate_argnums=donate_argnums,
-        )
+        # trace-only work must not read as compile activity: mask the
+        # jit layer's compile/retrace event log for the analysis trace
+        # (the telemetry analogue of Engine.check_decode snapshotting
+        # the traced-body compile probes)
+        from ..observability import jit_events
+
+        with jit_events.suppress():
+            tr = trace(
+                fn, args, dict(kwargs or {}),
+                static_argnums=static_argnums,
+                donate_argnums=donate_argnums,
+            )
     except Exception as e:
         # same degradation contract as a crashing pass: an analyzer
         # failure (here: the trace itself, beyond the graph-break

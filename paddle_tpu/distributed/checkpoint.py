@@ -35,6 +35,7 @@ import zlib
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import metrics as _obs_metrics
 from ..resilience import faults
 from .dist_tensor import shard_tensor, to_global_array
 from .placement import Partial, Replicate, Shard
@@ -57,6 +58,28 @@ _publish_lock = threading.Lock()
 
 class CheckpointCorruptError(RuntimeError):
     """No verifiable checkpoint could be loaded from the path."""
+
+
+# always-on pipeline timings (docs/observability.md): checkpoint
+# cadence is an SLO input — save time bounds how often you can
+# checkpoint, verify time is the recovery critical path, and the
+# fallback counter should be zero on a healthy fleet
+_save_s = _obs_metrics.histogram(
+    "paddle_tpu_checkpoint_save_seconds",
+    "write+fsync+verify+publish wall clock per checkpoint save",
+)
+_verify_s = _obs_metrics.histogram(
+    "paddle_tpu_checkpoint_verify_seconds",
+    "end-to-end checksum verification per checkpoint dir",
+)
+_rotate_s = _obs_metrics.histogram(
+    "paddle_tpu_checkpoint_rotate_seconds",
+    "keep_last_k rotation wall clock per publish",
+)
+_fallbacks = _obs_metrics.counter(
+    "paddle_tpu_checkpoint_load_fallbacks_total",
+    "loads that skipped a corrupt newest checkpoint",
+)
 
 
 def _placement_to_json(p):
@@ -135,6 +158,16 @@ def _verify_dir(d):
     checkpoint is never fully resident during verification. Raises
     CheckpointCorruptError on any damage so callers can fall back to an
     older checkpoint."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    try:
+        return _verify_dir_inner(d)
+    finally:
+        _verify_s.observe(_time.perf_counter() - t0)
+
+
+def _verify_dir_inner(d):
     try:
         with open(os.path.join(d, _META_FILE)) as f:
             payload = json.load(f)
@@ -251,11 +284,15 @@ def _publish(path, tmp, keep_last_k):
                 os.replace(vtmp, os.path.join(path, fname))
             _fsync_dir(path)
         if keep_last_k:
+            import time as _time
+
+            t0 = _time.perf_counter()
             for old in _ckpt_names(path)[keep_last_k:]:
                 if old != name:
                     shutil.rmtree(
                         os.path.join(path, old), ignore_errors=True
                     )
+            _rotate_s.observe(_time.perf_counter() - t0)
 
 
 def save_state_dict(state_dict, path, process_group=None,
@@ -346,6 +383,9 @@ def save_state_dict(state_dict, path, process_group=None,
     }
 
     def _write():
+        import time as _time
+
+        t0 = _time.perf_counter()
         # checksums computed HERE so async_save's foreground cost stays
         # the snapshot copy alone (the crc pass rides the writer thread)
         checksums = {k: _crc(v) for k, v in ndarrays.items()}
@@ -369,6 +409,7 @@ def save_state_dict(state_dict, path, process_group=None,
             # a torn/corrupt write must never become the latest pointer
             _verify_dir(tmp)
             _publish(path, tmp, keep_last_k)
+            _save_s.observe(_time.perf_counter() - t0)
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
@@ -484,6 +525,13 @@ def _read_checkpoint(path):
             errors.append(str(e))
             continue
         if errors:
+            _fallbacks.inc()
+            from ..observability import flight
+
+            flight.record(
+                "checkpoint", "fallback", loaded=name,
+                skipped="; ".join(errors),
+            )
             sys.stderr.write(
                 "[checkpoint] fell back to %s after: %s\n"
                 % (name, "; ".join(errors))

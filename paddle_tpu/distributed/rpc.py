@@ -79,8 +79,17 @@ class _RpcHandler(socketserver.BaseRequestHandler):
         if buf is None:
             return
         try:
-            fn, args, kwargs = pickle.loads(buf)
-            result = (True, fn(*args, **kwargs))
+            payload = pickle.loads(buf)
+            # 4th element: the caller's traceparent (older peers send
+            # 3-tuples; the contract stays compatible both ways)
+            fn, args, kwargs = payload[:3]
+            tp = payload[3] if len(payload) > 3 else None
+            from ..observability import remote_span
+
+            with remote_span(
+                f"rpc.{getattr(fn, '__name__', 'call')}", tp
+            ):
+                result = (True, fn(*args, **kwargs))
         except Exception as e:  # ship the failure back to the caller
             result = (False, e)
         payload = pickle.dumps(result)
@@ -157,9 +166,14 @@ def _connect_peer(info, timeout):
     return socket.create_connection((info.ip, info.port), timeout=timeout)
 
 
-def _call(to, fn, args, kwargs, timeout):
+def _call(to, fn, args, kwargs, timeout, tp=None):
     info = _state["infos"][to] if isinstance(to, str) else to
-    payload = pickle.dumps((fn, args or (), kwargs or {}))
+    # the traceparent rides as a 4th tuple element only when one
+    # exists — untraced traffic stays a 3-tuple, byte-compatible with
+    # peers that predate trace propagation (same rule as the store's
+    # optional "tp" frame field)
+    msg = (fn, args or (), kwargs or {})
+    payload = pickle.dumps(msg if tp is None else msg + (tp,))
     # deadline derived from the CALL timeout: retries ride inside the
     # caller's budget instead of multiplying it
     policy = RetryPolicy(
@@ -179,13 +193,22 @@ def _call(to, fn, args, kwargs, timeout):
 
 def rpc_sync(to, fn, args=None, kwargs=None, timeout=180.0):
     """Blocking remote call (ref rpc/rpc.py:rpc_sync)."""
-    return _call(to, fn, args, kwargs, timeout)
+    from ..observability import current_traceparent
+
+    return _call(to, fn, args, kwargs, timeout,
+                 tp=current_traceparent())
 
 
 def rpc_async(to, fn, args=None, kwargs=None, timeout=180.0):
     """Returns a Future (ref rpc/rpc.py:rpc_async -> FutureWrapper;
-    .wait() for the result)."""
-    fut = _state["pool"].submit(_call, to, fn, args, kwargs, timeout)
+    .wait() for the result). The trace context is captured at SUBMIT
+    time (the pool thread has no caller contextvars)."""
+    from ..observability import current_traceparent
+
+    fut = _state["pool"].submit(
+        _call, to, fn, args, kwargs, timeout,
+        tp=current_traceparent(),
+    )
     fut.wait = fut.result  # paddle Future API
     return fut
 

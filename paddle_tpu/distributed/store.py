@@ -62,6 +62,8 @@ def _recv_frame(sock):
 
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
+        from ..observability import remote_span
+
         store = self.server.kv_owner
         while True:
             req = _recv_frame(self.request)
@@ -69,7 +71,12 @@ class _Handler(socketserver.BaseRequestHandler):
                 return
             op = req["op"]
             key = req.get("key", "")
-            with store._cond:
+            # trace-context propagation: a client _rpc carrying a
+            # traceparent gets a server-side child span, so a request
+            # can be followed across the coordination plane; untraced
+            # traffic (barrier polls) skips span creation entirely
+            with remote_span(f"store.{op}", req.get("tp"), key=key), \
+                    store._cond:
                 if op == "set":
                     # one server-side op: drop the superseded typed twin
                     # and write the new entry under the same lock, so a
@@ -202,6 +209,14 @@ class TCPStore:
 
     def _rpc(self, op, key="", value=None, **extra):
         frame = {"op": op, "key": key, "value": value, **extra}
+        # attach the caller's trace context (one string field) so the
+        # server can parent its span onto ours; absent when no span is
+        # open, keeping plain coordination traffic byte-identical
+        from ..observability import current_traceparent
+
+        tp = current_traceparent()
+        if tp is not None:
+            frame["tp"] = tp
 
         def attempt():
             faults.fire("store.rpc", op=op, key=key)

@@ -50,7 +50,7 @@ class CommWatchdog:
         self._stop = threading.Event()
         self.fired = None      # (tag, why) after a trip
         self._seen_abort = None  # last ABORT_KEY value acted on
-        self._probes = {}      # name -> callable() -> dict, dumped on trip
+        self._probes = {}      # name -> (probe fn, owner weakref|None)
         if store is not None:
             try:  # a fresh watchdog must not trip on a PREVIOUS abort
                 store.delete_key(ABORT_KEY)
@@ -89,15 +89,40 @@ class CommWatchdog:
     def watch(self, tag, timeout=None):
         return self._Scope(self, tag, timeout or self.timeout)
 
-    def register_probe(self, name, fn):
+    def register_probe(self, name, fn, owner=None):
         """Attach a health probe (e.g. ``serving.Engine.health``); its
         snapshot is dumped next to the thread stacks when the watchdog
         trips, so a hang report carries subsystem state. Probes are
         only INVOKED at trip time (they may touch wedged subsystems);
         one that returns None — its target was garbage-collected — is
         pruned by the trip dump. Register through a weakref closure so
-        a dead target costs a dict entry, not its object graph."""
-        self._probes[name] = fn
+        a dead target costs a dict entry, not its object graph.
+
+        ``owner``: the probed object; held by weakref so registration
+        and trips can prune dead probes WITHOUT invoking them (an
+        invoke-to-check would defeat the only-at-trip-time rule).
+        Long-lived processes churn through probed objects (serving
+        engines per test/deploy), so dead entries are dropped every
+        time a new probe registers."""
+        import weakref
+
+        ref = None
+        if owner is not None:
+            try:
+                ref = weakref.ref(owner)
+            except TypeError:
+                ref = None  # unweakrefable owner: keep the probe forever
+        self._prune_probes()
+        self._probes[name] = (fn, ref)
+
+    def unregister_probe(self, name):
+        """Drop a probe; returns True if it was registered."""
+        return self._probes.pop(name, None) is not None
+
+    def _prune_probes(self):
+        for name, (fn, ref) in list(self._probes.items()):
+            if ref is not None and ref() is None:
+                self._probes.pop(name, None)
 
     def _register(self, tag, timeout):
         with self._lock:
@@ -159,15 +184,31 @@ class CommWatchdog:
         for tid, frame in sys._current_frames().items():
             sys.stderr.write(f"--- thread {tid} ---\n")
             sys.stderr.write("".join(traceback.format_stack(frame)))
-        for name, probe in list(self._probes.items()):
+        self._prune_probes()
+        probe_snaps = {}
+        for name, (probe, _ref) in list(self._probes.items()):
             try:
                 snap = probe()
                 if snap is None:  # probe target was garbage-collected
                     self._probes.pop(name, None)
                     continue
+                probe_snaps[name] = snap
                 sys.stderr.write(f"--- probe {name}: {snap!r}\n")
             except Exception as e:  # a broken probe must not mask the trip
+                probe_snaps[name] = {"error": repr(e)}
                 sys.stderr.write(f"--- probe {name} failed: {e!r}\n")
+        # postmortem: the flight recorder captures what led UP to the
+        # hang (recent compiles, fault fires, shed/poisoned requests)
+        # next to the probe snapshots; dump degrades its own failures
+        try:
+            from ..observability import flight
+
+            flight.record(
+                "watchdog", "trip", tag=tag, why=why, rank=self.rank,
+            )
+            flight.dump(f"watchdog-trip:{tag}", probes=probe_snaps)
+        except Exception as e:  # never mask the trip itself
+            sys.stderr.write(f"--- flight dump failed: {e!r}\n")
         if self.store is not None and why == "local timeout":
             try:  # propagate so peers abort instead of waiting
                 # timestamp nonce: a repeat abort of the same tag must

@@ -360,6 +360,21 @@ class _MPLoaderIter:
         for p in self._procs:
             p.join(timeout=max(0.0, deadline - time.monotonic()))
         stragglers = [p for p in self._procs if p.is_alive()]
+        if stragglers:
+            # escalation to SIGKILL is a fleet-health event: record it
+            # (a worker routinely ignoring SIGTERM is wedged in native
+            # code or masked signals — worth a postmortem entry)
+            from ..observability import flight, metrics
+
+            metrics.counter(
+                "paddle_tpu_dataloader_worker_kills_total",
+                "process workers that ignored SIGTERM and were "
+                "SIGKILLed at shutdown",
+            ).inc(len(stragglers))
+            flight.record(
+                "dataloader", "worker-kill",
+                pids=[p.pid for p in stragglers],
+            )
         for p in stragglers:
             p.kill()
         for p in stragglers:
@@ -455,6 +470,25 @@ class DataLoader:
             yield batch
 
     def __iter__(self):
+        # always-on pipeline telemetry: one counter bump per delivered
+        # batch (host-side, nanoseconds next to collate + H2D)
+        from ..observability import metrics as _obs_metrics
+
+        batches = _obs_metrics.counter(
+            "paddle_tpu_dataloader_batches_total",
+            "batches delivered to the training loop", ("transport",),
+        )
+        transport = (
+            "sync" if self.num_workers == 0
+            else "process" if (self.use_shared_memory
+                              and not self._iterable_mode)
+            else "thread"
+        )
+        for batch in self._iter_impl():
+            batches.inc(transport=transport)
+            yield batch
+
+    def _iter_impl(self):
         if self.num_workers == 0:
             for batch in self._produce():
                 yield _to_device(self.collate_fn(batch))

@@ -21,8 +21,18 @@ from ..core import autograd
 from ..core import random as random_mod
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
+from ..observability import jit_events
 
 _NOT_TO_STATIC = set()
+
+# monotonic instance tokens for the compile-log signatures: id(self)
+# is reused by the allocator after collection (and truncating it can
+# collide two LIVE instances), which would alias a fresh instance's
+# first compile onto a dead one's warm signature — a false
+# retrace-after-warmup alarm
+import itertools as _itertools  # noqa: E402
+
+_instance_tokens = _itertools.count(1)
 
 
 def not_to_static(fn):
@@ -168,6 +178,7 @@ class StaticFunction:
             )
         self._check = check
         self._checked_sigs = set()
+        self._instance_tok = next(_instance_tokens)
 
     def _run_check(self, args, kwargs, sig):
         """``to_static(check=...)`` choke point: on the first call per
@@ -202,6 +213,7 @@ class StaticFunction:
         def core(param_arrays, buffer_arrays, key, in_flat, in_meta):
             """in_flat: flat tensor-slot arrays; in_meta: (treedef, flat
             template with None at tensor slots, slot indices) — static."""
+            jit_events.mark_traced()  # compile/retrace event log
             treedef, template, slots = in_meta
             flat = list(template)
             for i, a in zip(slots, in_flat):
@@ -282,6 +294,21 @@ class StaticFunction:
         train_mode = autograd.is_grad_enabled() and any(
             not p.stop_gradient for p in params
         )
+        # compile/retrace event log: the watch supplies identity +
+        # elapsed for any trace core fires during this call; train and
+        # eval trace distinct programs (vjp vs plain), so they are
+        # distinct signatures, not retraces of each other
+        # the instance token keeps two DISTINCT functions that share a
+        # name (every Layer's 'forward') from reading as retraces of
+        # each other — the alarm must only fire when THIS function's
+        # already-warm signature traces again
+        _watch = jit_events.watch(
+            getattr(self._function, "__name__", "staged_fn"),
+            kind="to_static",
+            signature=f"{self._instance_tok:x}:"
+            f"{hash(sig) & 0xFFFFFFFF:08x}"
+            f":{'train' if train_mode else 'eval'}",
+        )
         if train_mode:
             core = self._core
             n_p = len(params)
@@ -304,9 +331,11 @@ class StaticFunction:
                 v if isinstance(v, Tensor) else Tensor(v, stop_gradient=True)
                 for v in slot_vals
             ]
-            results = dispatch.call(
-                "jit_program", impl, tuple(params) + tuple(in_tensors), {}
-            )
+            with _watch:
+                results = dispatch.call(
+                    "jit_program", impl,
+                    tuple(params) + tuple(in_tensors), {},
+                )
             results = (
                 list(results) if isinstance(results, (tuple, list))
                 else [results]
@@ -322,9 +351,11 @@ class StaticFunction:
                     b._rebind(nb.detach()._data)
             return jax.tree_util.tree_unflatten(self._out_tree, out_flat)
 
-        outs, new_buf, _, nflags = self._core(
-            [p._data for p in params], buf_arrays, key, in_arrays, in_meta
-        )
+        with _watch:
+            outs, new_buf, _, nflags = self._core(
+                [p._data for p in params], buf_arrays, key, in_arrays,
+                in_meta,
+            )
         if self._built_nan:
             self._nan_nets[self._cur_nan_key].raise_if(nflags)
         for b, a in zip(self._buffers, new_buf):
@@ -431,6 +462,7 @@ class TrainStep:
         self._live_idx = None  # params that actually received grads
         self._nan_nets = {}
         self._cur_nan_key = None
+        self._instance_tok = next(_instance_tokens)
 
     def _build(self):
         model, loss_fn, opt = self._model, self._loss_fn, self._opt
@@ -441,6 +473,7 @@ class TrainStep:
 
         def staged(param_arrays, buffer_arrays, states, lr, t, found_inf,
                    key, tree_args):
+            jit_events.mark_traced()  # compile/retrace event log
             old_p = _swap_payloads(params, param_arrays)
             old_b = _swap_payloads(buffers, buffer_arrays)
             saved = [(p.grad, p._grad_node, p._out_index, p.stop_gradient)
@@ -508,6 +541,7 @@ class TrainStep:
         def staged_accum(param_arrays, buffer_arrays, states, lr, t,
                          found_inf, key, tree_args):
             """accum_steps>1: scan k micro-batches, one update."""
+            jit_events.mark_traced()  # compile/retrace event log
             k = self._accum
             old_p = _swap_payloads(params, param_arrays)
             old_b = _swap_payloads(buffers, buffer_arrays)
@@ -696,12 +730,18 @@ class TrainStep:
                 if hasattr(a, "shape")
             ),
         )
-        (new_params, new_buffers, new_states, loss_val, _,
-         nan_flags) = self._compiled(
-            [p._data for p in self._params],
-            [b._data for b in self._buffers],
-            states, lr, t, found_inf, key, tree_args,
-        )
+        with jit_events.watch(
+            getattr(self._loss_fn, "__name__", "train_step"),
+            kind="train_step",
+            signature=f"{self._instance_tok:x}:"
+            f"{hash(self._cur_nan_key) & 0xFFFFFFFF:08x}",
+        ):
+            (new_params, new_buffers, new_states, loss_val, _,
+             nan_flags) = self._compiled(
+                [p._data for p in self._params],
+                [b._data for b in self._buffers],
+                states, lr, t, found_inf, key, tree_args,
+            )
         with autograd.no_grad():
             for p, a, ns in zip(self._params, new_params, new_states):
                 p._rebind(a)

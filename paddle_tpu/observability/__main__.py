@@ -1,0 +1,119 @@
+"""CLI: read flight-recorder postmortems / scrape the live registry.
+
+    python -m paddle_tpu.observability dump            # newest postmortem
+    python -m paddle_tpu.observability dump FILE.json  # a specific one
+    python -m paddle_tpu.observability dump --list     # enumerate dumps
+    python -m paddle_tpu.observability metrics         # this process's
+                                                       # exposition (mostly
+                                                       # useful under -i)
+
+Postmortems are written by ``observability.flight.dump`` on watchdog
+trips, unhandled engine errors, and SIGUSR2; they live under
+``$PADDLE_TPU_FLIGHT_DIR`` (default: the system temp dir).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _fmt_ts(ts):
+    try:
+        return time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+    except (TypeError, ValueError, OSError):
+        return str(ts)
+
+
+def _render_dump(payload, out):
+    out.write(
+        f"flight recorder postmortem — reason: {payload.get('reason')}\n"
+        f"  pid {payload.get('pid')}  at {_fmt_ts(payload.get('ts'))}\n"
+    )
+    probes = payload.get("probes") or {}
+    if probes:
+        out.write("-- probes " + "-" * 50 + "\n")
+        for name, snap in probes.items():
+            out.write(f"  {name}: {json.dumps(snap)}\n")
+    clog = payload.get("compile_log") or []
+    if clog:
+        out.write("-- compile log (oldest first) " + "-" * 30 + "\n")
+        for ev in clog:
+            mark = "RETRACE" if ev.get("retrace") else "compile"
+            el = ev.get("elapsed_s")
+            out.write(
+                f"  {_fmt_ts(ev.get('ts'))} {mark:<8}"
+                f" {ev.get('kind')}:{ev.get('fn')}"
+                f" sig={ev.get('signature')}"
+                + (f" {el:.3f}s" if el is not None else "")
+                + "\n"
+            )
+    events = payload.get("events") or []
+    if events:
+        out.write(f"-- last {len(events)} events " + "-" * 38 + "\n")
+        for ev in events:
+            extra = {
+                k: v for k, v in ev.items()
+                if k not in ("ts", "category", "name")
+            }
+            out.write(
+                f"  {_fmt_ts(ev.get('ts'))} [{ev.get('category')}] "
+                f"{ev.get('name')}"
+                + (f" {json.dumps(extra)}" if extra else "")
+                + "\n"
+            )
+    m = payload.get("metrics") or {}
+    if m:
+        out.write("-- metrics snapshot " + "-" * 40 + "\n")
+        for key in sorted(m):
+            out.write(f"  {key} = {m[key]}\n")
+
+
+def main(argv=None):
+    from . import flight, metrics
+
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.observability",
+        description="flight-recorder postmortems and metrics",
+    )
+    sub = parser.add_subparsers(dest="cmd")
+    p_dump = sub.add_parser("dump", help="render a postmortem file")
+    p_dump.add_argument(
+        "file", nargs="?", help="dump file (default: the newest)"
+    )
+    p_dump.add_argument(
+        "--list", action="store_true", help="list available dumps"
+    )
+    sub.add_parser("metrics", help="print this process's exposition")
+    args = parser.parse_args(argv)
+
+    if args.cmd == "metrics":
+        sys.stdout.write(metrics.get_registry().render_prometheus())
+        return 0
+    if args.cmd != "dump":
+        parser.print_help()
+        return 2
+    if args.list:
+        for p in flight.find_dumps():
+            print(p)
+        return 0
+    path = args.file
+    if path is None:
+        dumps = flight.find_dumps()
+        if not dumps:
+            print(
+                f"no postmortems under {flight.dump_dir()} "
+                "(set PADDLE_TPU_FLIGHT_DIR?)", file=sys.stderr,
+            )
+            return 1
+        path = dumps[0]
+    with open(path) as f:
+        payload = json.load(f)
+    print(f"# {path}")
+    _render_dump(payload, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

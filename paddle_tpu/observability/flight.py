@@ -1,0 +1,200 @@
+"""Flight recorder: a bounded ring of recent events + crash-time dump.
+
+Metrics say *how much*; the flight recorder says *what just happened*.
+Product code appends cheap host-side events — compiles/retraces
+(``jit_events``), serving preemptions, shed/timed-out/poisoned
+requests, fault-injection fires, watchdog probe snapshots — into a ring
+buffer that costs one deque append per event and never grows. On a
+failure worth a postmortem the whole ring, the compile log, a metrics
+snapshot, and the caller's probe snapshots are dumped to one JSON file:
+
+  * a comm-watchdog trip (``distributed.watchdog`` calls :func:`dump`
+    next to its thread-stack dump),
+  * an unhandled engine error (``serving.Engine.step`` dumps before
+    re-raising),
+  * ``SIGUSR2`` (operator-initiated: ``kill -USR2 <pid>`` on a live
+    but suspicious process), installed by :func:`install_signal_handler`.
+
+Dumps land under ``$PADDLE_TPU_FLIGHT_DIR`` (default: the system temp
+dir) as ``paddle_tpu-flight-<pid>-<n>.json``; read them with
+``python -m paddle_tpu.observability dump``. Dumping is an exporter:
+it fires the ``obs.export`` fault site and degrades every failure to a
+logged warning — a postmortem writer must never be the thing that
+crashes serving.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import warnings
+from collections import deque
+
+__all__ = [
+    "FlightRecorder", "get_flight_recorder", "record", "dump",
+    "dump_dir", "find_dumps", "install_signal_handler",
+]
+
+_DUMP_PREFIX = "paddle_tpu-flight-"
+
+
+class FlightRecorder:
+    """Thread-safe bounded event ring."""
+
+    def __init__(self, capacity=512):
+        self._events = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self.dumps = 0          # postmortems written by this recorder
+
+    def record(self, category, name, **data):
+        """Append one event. Values should be JSON-friendly scalars;
+        anything else is stringified at dump time, never here (the
+        recording path stays allocation-cheap)."""
+        ev = {"ts": time.time(), "category": category, "name": name}
+        if data:
+            ev.update(data)
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self):
+        with self._lock:
+            return [dict(ev) for ev in self._events]
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._events)
+
+
+_recorder = FlightRecorder()
+_dump_lock = threading.Lock()
+
+
+def get_flight_recorder():
+    return _recorder
+
+
+def record(category, name, **data):
+    """Append an event to the process-wide flight recorder."""
+    _recorder.record(category, name, **data)
+
+
+def dump_dir():
+    return os.environ.get("PADDLE_TPU_FLIGHT_DIR") or tempfile.gettempdir()
+
+
+def _json_safe(obj):
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        if isinstance(obj, dict):
+            return {str(k): _json_safe(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return [_json_safe(v) for v in obj]
+        return repr(obj)
+
+
+def dump(reason, path=None, probes=None):
+    """Write the postmortem file: ring events, the jit compile log, a
+    metrics snapshot, and ``probes`` (name -> snapshot dict, e.g. the
+    watchdog's probe sweep / ``Engine.health()``). Returns the file
+    path, or None after degrading a failure to a warning."""
+    from ..resilience import faults
+    from . import jit_events, metrics
+
+    try:
+        faults.fire("obs.export", what="flight", reason=reason)
+        if path is None:
+            # name allocation under a lock: a watchdog-thread trip and
+            # the main thread's engine-error dump can fire together,
+            # and two dumps interleaving into one file is exactly the
+            # torn postmortem the tmp+replace dance exists to prevent
+            with _dump_lock:
+                _recorder.dumps += 1
+                n = _recorder.dumps
+            path = os.path.join(
+                dump_dir(),
+                f"{_DUMP_PREFIX}{os.getpid()}-{n:03d}.json",
+            )
+        payload = {
+            "reason": reason,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "argv": sys.argv,
+            "events": _json_safe(_recorder.events()),
+            "compile_log": _json_safe(jit_events.compile_log()),
+            "metrics": _json_safe(metrics.get_registry().snapshot()),
+            "probes": _json_safe(probes or {}),
+        }
+        tmp = f"{path}.{os.getpid()}-{threading.get_ident():x}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1)
+        os.replace(tmp, path)  # a torn postmortem helps nobody
+        sys.stderr.write(f"[flight] {reason}: dumped {path}\n")
+        return path
+    except Exception as e:
+        warnings.warn(
+            f"flight-recorder dump ({reason!r}) failed (degraded, "
+            f"nothing crashed): {e!r}",
+            stacklevel=2,
+        )
+        return None
+
+
+def find_dumps(directory=None):
+    """Postmortem files in ``directory`` (default: :func:`dump_dir`),
+    newest first."""
+    d = directory or dump_dir()
+    try:
+        names = [
+            n for n in os.listdir(d)
+            if n.startswith(_DUMP_PREFIX) and n.endswith(".json")
+        ]
+    except OSError:
+        return []
+    paths = [os.path.join(d, n) for n in names]
+
+    def mtime(p):
+        # the reader must keep working while a cleanup job races it —
+        # a dump deleted between listdir and stat sorts last, not crash
+        try:
+            return os.path.getmtime(p)
+        except OSError:
+            return 0.0
+
+    return sorted(paths, key=mtime, reverse=True)
+
+
+_signal_installed = False
+
+
+def install_signal_handler(signum=None):
+    """Install the ``SIGUSR2 -> dump("sigusr2")`` handler (idempotent;
+    main thread only — a no-op elsewhere, returns True iff
+    installed)."""
+    global _signal_installed
+    if _signal_installed:
+        return True
+    signum = signum if signum is not None else getattr(
+        signal, "SIGUSR2", None
+    )
+    if signum is None:
+        return False
+
+    def _handler(sig, frame):
+        dump("sigusr2")
+
+    try:
+        signal.signal(signum, _handler)
+    except ValueError:  # not the main thread
+        return False
+    _signal_installed = True
+    return True

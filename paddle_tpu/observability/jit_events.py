@@ -1,0 +1,187 @@
+"""Compile/retrace event log for the jit layer.
+
+Every XLA trace in the process — ``jit.to_static`` staging,
+``jit.TrainStep``, the serving engine's prefill/decode programs —
+records an event (fn, kind, signature, elapsed wall clock) into a
+bounded log, increments ``paddle_tpu_jit_compiles_total{kind}``, and
+lands in the flight recorder. A trace for a *(fn, signature)* pair that
+was already traced once is a **retrace after warmup** — the classic
+silent serving-latency killer (a shape or weak type leaked into a hot
+path) — and additionally bumps the alarmable
+``paddle_tpu_jit_retraces_after_warmup_total{kind}`` counter, turning
+"the bench got slow and flaky" into a monitorable signal.
+
+Mechanics: call sites wrap the jitted call in :func:`watch` (host-side,
+a thread-local push/pop — nanoseconds when nothing traces) and the
+traced body calls :func:`mark_traced` at its top. The body of a
+``jax.jit`` function only executes while XLA is TRACING it, so
+``mark_traced`` fires exactly on compiles and is free on the warm
+path; the enclosing ``watch`` supplies the event's identity and
+measures elapsed time (trace + compile + first run).
+
+``suppress()`` masks the hooks for trace-only work: ``analysis.check``
+traces programs through the same machinery without ever compiling or
+running them, and must not read as compile activity (the same
+probe-snapshot discipline ``Engine.check_decode`` applies to the
+traced-body compile counters).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from . import metrics as _metrics
+
+__all__ = [
+    "watch", "mark_traced", "suppress", "compile_log",
+    "clear_compile_log", "retraces_after_warmup",
+]
+
+_tls = threading.local()
+
+_lock = threading.Lock()
+_log: deque = deque(maxlen=256)
+_seen: dict = {}      # (name, kind, signature) -> trace count
+
+_compiles = _metrics.counter(
+    "paddle_tpu_jit_compiles_total",
+    "XLA traces recorded by the jit layer", ("kind",),
+)
+_retraces = _metrics.counter(
+    "paddle_tpu_jit_retraces_after_warmup_total",
+    "traces of a (fn, signature) pair that was already traced once — "
+    "a shape/weak-type leak into a warm hot path", ("kind",),
+)
+
+
+def _watch_stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _suppressed():
+    return getattr(_tls, "suppress", 0) > 0
+
+
+class suppress:
+    """Mask compile-event recording for the dynamic extent (used by the
+    trace-only analyzer so its traces never read as compiles)."""
+
+    def __enter__(self):
+        _tls.suppress = getattr(_tls, "suppress", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _tls.suppress -= 1
+        return False
+
+
+class watch:
+    """Wrap one jitted call; supplies identity + elapsed time for any
+    trace that fires inside it::
+
+        with jit_events.watch("decode", kind="serving", signature="s"):
+            out = decode_jit(...)
+    """
+
+    def __init__(self, name, kind="jit", signature=""):
+        self.name = name
+        self.kind = kind
+        self.signature = str(signature)
+        self.events = []
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        _watch_stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        st = _watch_stack()
+        if st and st[-1] is self:
+            st.pop()
+        else:  # defensive: unbalanced exits must not corrupt the stack
+            try:
+                st.remove(self)
+            except ValueError:
+                pass
+        if self.events:
+            elapsed = time.perf_counter() - self._t0
+            for ev in self.events:
+                ev["elapsed_s"] = elapsed
+                _emit(ev)
+        return False
+
+
+def mark_traced(name=None, kind=None, signature=None):
+    """Called from INSIDE a traced body (runs only while XLA traces).
+    Identity defaults come from the enclosing :class:`watch`; an
+    unwatched trace is still logged under the explicit (or
+    ``<untracked>``) name with no elapsed time."""
+    if _suppressed():
+        return
+    st = _watch_stack()
+    w = st[-1] if st else None
+    name = name if name is not None else (w.name if w else "<untracked>")
+    kind = kind if kind is not None else (w.kind if w else "jit")
+    signature = (
+        str(signature) if signature is not None
+        else (w.signature if w else "")
+    )
+    key = (name, kind, signature)
+    with _lock:
+        count = _seen[key] = _seen.get(key, 0) + 1
+    retrace = count > 1
+    _compiles.inc(kind=kind)
+    if retrace:
+        _retraces.inc(kind=kind)
+    ev = {
+        "ts": time.time(),
+        "fn": name,
+        "kind": kind,
+        "signature": signature,
+        "trace_no": count,
+        "retrace": retrace,
+        "elapsed_s": None,
+    }
+    if w is not None:
+        w.events.append(ev)   # elapsed filled at watch exit
+    else:
+        _emit(ev)
+
+
+def _emit(ev):
+    with _lock:
+        _log.append(ev)
+    from . import flight
+
+    flight.record(
+        "compile", ev["fn"], kind=ev["kind"],
+        signature=ev["signature"], retrace=ev["retrace"],
+        elapsed_s=ev["elapsed_s"],
+    )
+
+
+def compile_log():
+    """The bounded compile/retrace event log, oldest first."""
+    with _lock:
+        return [dict(ev) for ev in _log]
+
+
+def clear_compile_log():
+    """Reset the log and the warmup bookkeeping (tests)."""
+    with _lock:
+        _log.clear()
+        _seen.clear()
+
+
+def retraces_after_warmup(kind=None):
+    """Total retrace-after-warmup count (optionally for one kind)."""
+    fam = _retraces.family()
+    return sum(
+        v for _, labels, v in fam.samples
+        if kind is None or labels.get("kind") == kind
+    )

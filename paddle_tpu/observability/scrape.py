@@ -1,0 +1,160 @@
+"""Optional scrape endpoint: ``/metrics`` + ``/healthz`` on a thread.
+
+One background ``ThreadingHTTPServer`` makes the process observable to
+a standard Prometheus scraper and a load-balancer health check without
+any framework dependency:
+
+  * ``GET /metrics``  — the default registry's text exposition
+  * ``GET /healthz``  — JSON aggregation of registered health
+    providers (``serving.Engine`` registers its ``health()`` snapshot
+    automatically); HTTP 200 when every provider reports ``status:
+    "ok"``, 503 otherwise (a degraded/overloaded replica should be
+    rotated out, not sent more traffic)
+
+Export failures fire the ``obs.export`` fault site and degrade to an
+HTTP 500 plus a logged warning — a broken exporter must never crash
+(or stall) the serving loop it is observing.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import metrics as _metrics
+
+__all__ = [
+    "ScrapeServer", "start_scrape_server",
+    "register_health_provider", "unregister_health_provider",
+    "health_snapshot",
+]
+
+_providers_lock = threading.Lock()
+_providers: dict = {}   # name -> callable() -> dict | None
+
+
+def register_health_provider(name, fn):
+    """Attach a health snapshot callable (e.g. a weakref closure over
+    ``Engine.health``). A provider returning None — its target was
+    garbage-collected — is pruned at the next snapshot."""
+    with _providers_lock:
+        _providers[name] = fn
+
+
+def unregister_health_provider(name):
+    with _providers_lock:
+        _providers.pop(name, None)
+
+
+def health_snapshot():
+    """Aggregate provider snapshots: overall ``status`` is "ok" only
+    when every live provider says so (no providers -> "ok": a process
+    serving nothing is healthy)."""
+    with _providers_lock:
+        items = list(_providers.items())
+    out = {"status": "ok", "providers": {}}
+    dead = []
+    for name, fn in items:
+        try:
+            snap = fn()
+        except Exception as e:  # one broken probe must not 503 the rest
+            snap = {"status": "degraded", "error": repr(e)}
+        if snap is None:
+            dead.append(name)
+            continue
+        out["providers"][name] = snap
+        status = snap.get("status", "ok") if isinstance(snap, dict) else "ok"
+        if status != "ok" and out["status"] == "ok":
+            out["status"] = str(status)
+    if dead:
+        with _providers_lock:
+            for name in dead:
+                _providers.pop(name, None)
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # quiet: CI logs, not access logs
+        return
+
+    def _send(self, code, body, ctype):
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        from ..resilience import faults
+
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                faults.fire("obs.export", what="scrape", path=path)
+                body = self.server.registry.render_prometheus()
+                self._send(
+                    200, body, "text/plain; version=0.0.4; charset=utf-8"
+                )
+            elif path == "/healthz":
+                faults.fire("obs.export", what="healthz", path=path)
+                snap = health_snapshot()
+                code = 200 if snap["status"] == "ok" else 503
+                self._send(code, json.dumps(snap), "application/json")
+            else:
+                self._send(404, "not found\n", "text/plain")
+        except Exception as e:
+            # exporter degradation contract: warn + 500, never propagate
+            sys.stderr.write(
+                f"[observability] scrape of {path} failed (degraded): "
+                f"{e!r}\n"
+            )
+            try:
+                self._send(500, "scrape failed\n", "text/plain")
+            except OSError:
+                pass  # peer already gone; nothing left to degrade to
+
+
+class ScrapeServer:
+    """Handle to the running endpoint (``.port``, ``.url``,
+    ``.close()``)."""
+
+    def __init__(self, host="127.0.0.1", port=0, registry=None):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.registry = registry or _metrics.get_registry()
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="paddle_tpu-scrape",
+        )
+        self._thread.start()
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def start_scrape_server(port=0, host="127.0.0.1", registry=None):
+    """Start the `/metrics` + `/healthz` thread (``port=0`` picks a
+    free port — read it off the returned server). Also installs the
+    SIGUSR2 flight-dump handler: a scraped process is a production
+    process, so give operators the postmortem trigger too."""
+    from . import flight
+
+    flight.install_signal_handler()
+    return ScrapeServer(host=host, port=port, registry=registry)

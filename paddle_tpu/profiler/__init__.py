@@ -75,7 +75,26 @@ def export_chrome_tracing(dir_name, worker_name=None):
 
 
 def export_protobuf(dir_name, worker_name=None):
-    return export_chrome_tracing(dir_name, worker_name)
+    """on_trace_ready callback for the protobuf exporter (ref
+    profiler.py:247 export_protobuf).
+
+    Both exporters produce the same TensorBoard xplane artifact here
+    (the PJRT tracer has one output format), but this handler writes to
+    a distinct ``protobuf/`` subdirectory of ``dir_name`` — a
+    reference-ported script wiring one profiler to export_chrome_tracing
+    and another to export_protobuf with the SAME dir no longer has the
+    second silently overwrite the first's traces — and says so
+    explicitly instead of silently aliasing."""
+    import warnings
+
+    sub = os.path.join(dir_name, "protobuf")
+    warnings.warn(
+        "export_protobuf on TPU emits the same TensorBoard xplane "
+        f"artifact as export_chrome_tracing; writing to {sub!r} so the "
+        "two exporters never overwrite each other",
+        stacklevel=2,
+    )
+    return export_chrome_tracing(sub, worker_name)
 
 
 def load_profiler_result(path):
@@ -92,10 +111,20 @@ def load_profiler_result(path):
 # aggregated table.
 
 _op_stats: dict | None = None
+_jax_tracing = 0   # jax.profiler.start_trace sessions in flight
 
 
 def _stats_active():
     return _op_stats is not None
+
+
+def _session_active():
+    """True while a profiler session is recording (op stats window or a
+    device trace). ``observability.spans`` uses this to skip the
+    TraceAnnotation + stats work on the serving hot path when nobody is
+    profiling — an annotation with no session behind it costs tens of
+    microseconds per step and records nothing."""
+    return _op_stats is not None or _jax_tracing > 0
 
 
 def _record_span(name, seconds, category="op"):
@@ -243,6 +272,7 @@ class Profiler:
                 self._on_trace_ready(self)
 
     def _start_trace(self):
+        global _jax_tracing
         self._log_dir = (
             self._export_dir
             or getattr(self._on_trace_ready, "dir_name", None)
@@ -250,10 +280,13 @@ class Profiler:
         )
         jax.profiler.start_trace(self._log_dir)
         self._tracing = True
+        _jax_tracing += 1
 
     def _stop_trace(self):
+        global _jax_tracing
         jax.profiler.stop_trace()
         self._tracing = False
+        _jax_tracing = max(0, _jax_tracing - 1)
 
     def __enter__(self):
         return self.start()

@@ -33,6 +33,16 @@ docs/resilience.md):
                        rule=) — lets tests assert a crashing analyzer
                        degrades (check="warn") instead of killing the
                        caller
+    obs.export         one observability exporter invocation (context:
+                       what= "scrape"/"healthz"/"flight"/
+                       "chrome_trace") — exporter/scrape failures must
+                       degrade to a logged warning, never crash the
+                       training or serving they observe
+
+Every injected fault is itself telemetry: the moment a spec fires it is
+counted in ``paddle_tpu_resilience_fault_fires_total{site}`` and logged
+to the observability flight recorder, so a postmortem shows which
+injected (or test-scheduled) faults preceded the failure.
 
 Schedules are deterministic: occurrence-number triggers (``at``/
 ``every``) count ``fire()`` calls per site per injector, and the
@@ -157,6 +167,7 @@ class FaultInjector:
                     break
             else:
                 return
+        _record_fire(site, context)
         # raise outside the lock: handlers may re-enter fire()
         spec._raise(site, context)
 
@@ -170,6 +181,28 @@ class FaultInjector:
         except ValueError:
             pass
         return False
+
+
+def _record_fire(site, context):
+    """Telemetry for an injected fault (counter + flight-recorder
+    event). Lazy import: resilience loads before observability in the
+    package graph, and a fork-inherited worker may fire before either
+    is imported. Telemetry must never break the injection itself."""
+    try:
+        from ..observability import flight, metrics
+
+        metrics.counter(
+            "paddle_tpu_resilience_fault_fires_total",
+            "injected faults actually fired, by site", ("site",),
+        ).inc(site=site)
+        flight.record(
+            "fault", site,
+            **{k: repr(v) for k, v in context.items()},
+        )
+    except Exception:
+        # analysis: allow(broad-except) telemetry is best-effort here;
+        # the scheduled fault must still raise even if recording fails
+        pass
 
 
 # Active injectors, innermost last. Plain module state on purpose: fork

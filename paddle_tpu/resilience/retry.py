@@ -83,9 +83,34 @@ class RetryPolicy:
                 )
                 if out_of_attempts or past_deadline:
                     raise
+                _count_retry(fn, e)
                 if on_retry is not None:
                     on_retry(e, attempt)
                 self._sleep(pause)
+
+
+def _count_retry(fn, exc):
+    """Telemetry: every retried attempt lands in
+    ``paddle_tpu_resilience_retries_total{fn,exc}`` — a fleet whose
+    coordination plane is silently retrying its way through flakiness
+    should show it on a dashboard before it becomes an outage. Lazy
+    import (retry loads before observability in the package graph) and
+    best-effort: counting must never break the retry."""
+    try:
+        from ..observability import metrics
+
+        metrics.counter(
+            "paddle_tpu_resilience_retries_total",
+            "retried attempts under RetryPolicy", ("fn", "exc"),
+        ).inc(
+            fn=getattr(fn, "__name__", "call"),
+            exc=type(exc).__name__,
+        )
+    except Exception:
+        # analysis: allow(broad-except) telemetry is best-effort; the
+        # backoff/retry semantics must be unaffected by a counting
+        # failure
+        pass
 
 
 def retry_call(fn, *args, policy=None, **kwargs):

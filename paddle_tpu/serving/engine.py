@@ -38,6 +38,7 @@ guarantee.
 from __future__ import annotations
 
 import collections
+import itertools
 import time
 
 import jax
@@ -46,7 +47,10 @@ import numpy as np
 
 from ..distributed.watchdog import CommTimeoutError, get_comm_watchdog
 from ..jit.bucketing import next_bucket
-from ..profiler import RecordEvent
+from ..observability import flight as _flight
+from ..observability import jit_events
+from ..observability import register_health_provider, span
+from ..observability import unregister_health_provider
 from ..resilience import faults
 from .adapter import build_adapter
 from .kv_cache import BlockManager, KVPool
@@ -61,6 +65,23 @@ class EngineOverloadedError(RuntimeError):
     """add_request rejected under KV pressure (load shedding): the
     caller should back off / route elsewhere rather than deepen an
     already-saturated queue."""
+
+
+# monotonic engine ids: id(self) gets reused by the allocator after an
+# engine is collected, which would alias a fresh engine's probes,
+# metric labels, and compile-log signatures onto a dead one's (a new
+# engine's first compile must never read as a retrace alarm)
+_engine_counter = itertools.count(1)
+
+
+def _unregister_engine_probes(name):
+    """weakref.finalize target: drop a collected engine's health
+    provider and watchdog probe (module-level so the finalizer holds no
+    reference back into the engine)."""
+    unregister_health_provider(name)
+    wd = get_comm_watchdog()
+    if wd is not None and hasattr(wd, "unregister_probe"):
+        wd.unregister_probe(name)
 
 
 def _default_buckets(max_model_len):
@@ -145,7 +166,10 @@ class Engine:
     def __init__(self, model, config=None):
         self.config = config or EngineConfig()
         self.adapter = build_adapter(model)
-        self.metrics = EngineMetrics()
+        self.engine_id = f"{next(_engine_counter):x}"
+        # the metrics object doubles as a registry collector view
+        # (paddle_tpu_serving_* series labeled engine=<id>)
+        self.metrics = EngineMetrics(engine_id=self.engine_id)
         cfg = self.config
         # pool dtype: the adapter may declare it; default to the embed
         # table's dtype for dict-shaped weights (the Llama adapter)
@@ -164,19 +188,26 @@ class Engine:
         self._base_key = jax.random.PRNGKey(cfg.seed)
         self._build_steps()
         # observability: a comm watchdog trip dumps this engine's health
-        # snapshot next to the thread stacks. Registered through a
-        # weakref so the watchdog never pins a dead engine (weights +
-        # KV pool) in memory; a collected engine's probe returns None
-        # and is skipped by the dump.
+        # snapshot next to the thread stacks, and the scrape endpoint's
+        # /healthz aggregates the same snapshot. Registered through a
+        # weakref so neither consumer pins a dead engine (weights + KV
+        # pool) in memory; weakref.finalize unregisters both when the
+        # engine is collected, so dead probes don't accumulate across
+        # engine lifetimes.
+        import weakref
+
+        def _probe(ref=weakref.ref(self)):
+            eng = ref()
+            return None if eng is None else eng.health()
+
+        probe_name = f"serving.engine.{self.engine_id}"
+        register_health_provider(probe_name, _probe)
         wd = get_comm_watchdog()
         if wd is not None and hasattr(wd, "register_probe"):
-            import weakref
-
-            def _probe(ref=weakref.ref(self)):
-                eng = ref()
-                return None if eng is None else eng.health()
-
-            wd.register_probe(f"serving.engine.{id(self):x}", _probe)
+            wd.register_probe(probe_name, _probe, owner=self)
+        weakref.finalize(
+            self, _unregister_engine_probes, probe_name
+        )
 
     # -- compiled steps ------------------------------------------------------
     def _build_steps(self):
@@ -197,6 +228,7 @@ class Engine:
                        temperature, top_k, top_p, do_sample, key,
                        any_sample):
             metrics.prefill_compiles += 1   # traced-body compile probe
+            jit_events.mark_traced()        # global compile/retrace log
             logits, kp, vp = adapter.prefill(
                 w, kp, vp, ids, length, block_table
             )
@@ -215,6 +247,7 @@ class Engine:
                       temperature, top_k, top_p, do_sample, key,
                       any_sample):
             metrics.decode_compiles += 1    # traced-body compile probe
+            jit_events.mark_traced()        # global compile/retrace log
             logits, kp, vp = adapter.decode(
                 w, kp, vp, tokens, positions, block_tables, active
             )
@@ -345,6 +378,14 @@ class Engine:
             )
             if util >= cfg.kv_shed_threshold and not admissible_now:
                 self.metrics.requests_shed += 1
+                # generate()'s internal admission retries undo the shed
+                # count (flow control, not a rejection) — they must not
+                # flood the bounded flight ring either
+                if not getattr(self, "_suppress_shed_events", False):
+                    _flight.record(
+                        "serving", "shed", engine=self.engine_id,
+                        request_id=req.request_id, kv_utilization=util,
+                    )
                 raise EngineOverloadedError(
                     f"KV pool at {util:.0%} utilization (threshold "
                     f"{cfg.kv_shed_threshold:.0%}); request shed"
@@ -393,7 +434,11 @@ class Engine:
             while pending and (cap is None or len(self.waiting) < cap):
                 p, sp = pending.popleft()
                 try:
-                    reqs.append(self.add_request(p, sp))
+                    self._suppress_shed_events = True
+                    try:
+                        reqs.append(self.add_request(p, sp))
+                    finally:
+                        self._suppress_shed_events = False
                 except EngineOverloadedError:
                     # flow control, not a caller-visible rejection: the
                     # prompt is resubmitted once the batch drains, so
@@ -416,14 +461,35 @@ class Engine:
         on ``RequestOutput.error``) while the engine keeps stepping the
         remaining requests — one poison request cannot take down the
         batch. Comm-watchdog aborts are NOT contained: a cluster-level
-        abort must propagate."""
+        abort must propagate. Anything that does escape (watchdog
+        abort, donated-pool loss) dumps the flight recorder with this
+        engine's health snapshot on the way out — the engine is about
+        to die, so leave the postmortem."""
         finished: list = []
-        self._expire(finished)
-        self._admit(finished)
-        if any(r is not None for r in self.slots):
-            self._ensure_capacity()
+        try:
+            self._expire(finished)
+            self._admit(finished)
             if any(r is not None for r in self.slots):
-                self._decode(finished)
+                self._ensure_capacity()
+                if any(r is not None for r in self.slots):
+                    self._decode(finished)
+        except Exception as e:
+            _flight.record(
+                "serving", "engine-error", engine=self.engine_id,
+                error=f"{type(e).__name__}: {e}",
+            )
+            # the engine is broken by definition here — health() itself
+            # may raise over torn state, and nothing on the postmortem
+            # path may displace the exception we are re-raising
+            try:
+                probe = self.health()
+            except Exception as he:
+                probe = {"error": f"health() failed: {he!r}"}
+            _flight.dump(
+                "engine-error",
+                probes={f"serving.engine.{self.engine_id}": probe},
+            )
+            raise
         m, bm = self.metrics, self.block_manager
         m.queue_depth = len(self.waiting)
         m.num_running = sum(r is not None for r in self.slots)
@@ -542,7 +608,14 @@ class Engine:
         table = np.zeros(cfg.pages_per_seq, np.int32)
         table[: len(req.block_ids)] = req.block_ids
         p = req.sampling_params
-        with RecordEvent("serving.prefill"), self._watch("serving.prefill"):
+        with span(
+            "serving.prefill", request_id=req.request_id, bucket=bucket,
+        ), self._watch("serving.prefill"), jit_events.watch(
+            # engine id in the signature: a SECOND engine compiling its
+            # own programs is a fresh compile, not a retrace alarm
+            "serving.prefill", kind="serving",
+            signature=f"{self.engine_id}:bucket={bucket}",
+        ):
             try:
                 tok, k, v = self._prefill_jit(
                     self.adapter.weights, self.pool.k, self.pool.v,
@@ -608,6 +681,10 @@ class Engine:
         req.num_cached = 0
         self.waiting.appendleft(req)
         self.metrics.preemptions += 1
+        _flight.record(
+            "serving", "preemption", engine=self.engine_id,
+            request_id=req.request_id,
+        )
 
     def _decode(self, finished):
         # one key per scheduler step, shared by isolation re-launches:
@@ -640,14 +717,20 @@ class Engine:
             "serving.step", phase="decode",
             request_ids=tuple(self.slots[i].request_id for i in idxs),
         )
-        with RecordEvent("serving.decode"), self._watch("serving.decode"):
+        any_sample = bool(params["do_sample"].any())
+        with span(
+            "serving.decode", active=len(idxs),
+        ), self._watch("serving.decode"), jit_events.watch(
+            "serving.decode", kind="serving",
+            signature=f"{self.engine_id}:any_sample={any_sample}",
+        ):
             try:
                 nxt, k, v = self._decode_jit(
                     self.adapter.weights, self.pool.k, self.pool.v,
                     tokens, positions, tables, active,
                     params["temperature"], params["top_k"],
                     params["top_p"], params["do_sample"], key,
-                    bool(params["do_sample"].any()),
+                    any_sample,
                 )
             except Exception as e:
                 # a failure from the dispatched program may have
@@ -716,6 +799,13 @@ class Engine:
             req.slot = None
 
     def _finish(self, req, reason, finished):
+        if reason in ("timeout", "error"):
+            # degradation events belong in the postmortem ring; normal
+            # completions (length/eos/stop) would only drown them out
+            _flight.record(
+                "serving", reason, engine=self.engine_id,
+                request_id=req.request_id, error=req.error,
+            )
         req.finish_reason = reason
         req.state = RequestState.FINISHED
         req.finish_time = time.perf_counter()

@@ -7,16 +7,76 @@ into the hot path) and preemptions (KV pool pressure). ``RecordEvent``
 spans from ``paddle_tpu.profiler`` wrap the prefill/decode steps, so a
 profiler session over a serving loop shows them in the UserDefined
 summary table and the trace viewer like any other annotated range.
+
+Registry view: every EngineMetrics publishes itself into the
+process-wide ``observability`` metrics registry as a pull-time
+collector (``paddle_tpu_serving_*`` series labeled by engine id).
+Nothing changes on the hot path — the counters stay plain python
+attributes (the traced-body compile probes depend on that), the
+registry PULLS ``snapshot()`` at scrape time, and a garbage-collected
+engine's view unregisters itself through the weakref.
 """
 from __future__ import annotations
 
 import time
+import weakref
 
 __all__ = ["EngineMetrics"]
 
+# snapshot key -> (exposition kind, suffix); monotonics get the
+# prometheus _total suffix, instantaneous values export as gauges
+_EXPORT_KINDS = {
+    "requests_received": ("counter", "_total"),
+    "requests_finished": ("counter", "_total"),
+    "preemptions": ("counter", "_total"),
+    "requests_errored": ("counter", "_total"),
+    "requests_timeout": ("counter", "_total"),
+    "requests_shed": ("counter", "_total"),
+    "prefill_tokens": ("counter", "_total"),
+    "decode_tokens": ("counter", "_total"),
+    "prefill_steps": ("counter", "_total"),
+    "decode_steps": ("counter", "_total"),
+    "prefill_compiles": ("counter", "_total"),
+    "decode_compiles": ("counter", "_total"),
+    "queue_depth": ("gauge", ""),
+    "num_running": ("gauge", ""),
+    "cache_utilization": ("gauge", ""),
+    "pool_high_water": ("gauge", ""),
+    "mean_ttft_s": ("gauge", ""),
+    "tokens_per_s": ("gauge", ""),
+}
+
+
+def _register_view(metrics, engine_id):
+    """Collector view over one EngineMetrics: called only at scrape
+    time, holds the metrics object by weakref (a dead engine's view
+    returns None and the registry drops it)."""
+    from ..observability import MetricFamily, get_registry
+
+    ref = weakref.ref(metrics)
+    label = {"engine": engine_id}
+
+    def collect():
+        m = ref()
+        if m is None:
+            return None
+        fams = []
+        for key, value in m.snapshot().items():
+            kind_suffix = _EXPORT_KINDS.get(key)
+            if kind_suffix is None or value is None:
+                continue  # non-numeric (last_error) / unset latencies
+            kind, suffix = kind_suffix
+            fams.append(MetricFamily(
+                f"paddle_tpu_serving_{key}{suffix}", kind,
+            ).add(value, label))
+        return fams
+
+    get_registry().register_collector(f"serving.engine.{engine_id}",
+                                      collect)
+
 
 class EngineMetrics:
-    def __init__(self):
+    def __init__(self, engine_id=None):
         self.start_time = time.perf_counter()
         # request flow
         self.requests_received = 0
@@ -45,6 +105,12 @@ class EngineMetrics:
         # latency
         self._ttft_sum = 0.0
         self._ttft_count = 0
+        # registry view (see module docstring), registered LAST: a
+        # scrape racing engine construction must find every attribute
+        # snapshot() reads already in place. The engine id labels this
+        # engine's series so replicas stay distinguishable.
+        if engine_id is not None:
+            _register_view(self, engine_id)
 
     def record_ttft(self, seconds):
         self._ttft_sum += seconds
