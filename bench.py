@@ -405,6 +405,74 @@ def bench_serving(paddle, on_tpu):
     return tps
 
 
+def bench_fleet(paddle, on_tpu):
+    """Replica-failover recovery (fleet row): ``fleet_failover_ms`` is
+    the kill-to-first-recovered-token wall clock — an injected
+    ``serving.replica`` fault kills one of two replicas mid-decode, its
+    in-flight requests are re-enqueued on the survivor (deterministic
+    re-prefill), and the clock stops when the first failed-over request
+    produces its next token. This is the serving-side RTO term next to
+    the checkpoint-restore one measured by the [resilience] row."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.resilience import FaultSpec, faults
+    from paddle_tpu.serving import (
+        EngineConfig, Fleet, FleetConfig, SamplingParams,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=12, num_attention_heads=16,
+        max_position_embeddings=2048,
+    ) if on_tpu else LlamaConfig.tiny()
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    n_req, slots, mml = (16, 8, 512) if on_tpu else (8, 4, 64)
+    fleet = Fleet(model, EngineConfig(
+        max_batch_slots=slots, max_model_len=mml,
+        page_size=16 if on_tpu else 8,
+    ), FleetConfig(num_replicas=2, analysis_check=None))
+    rng = np.random.RandomState(0)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, rng.randint(4, mml // 8)).tolist()
+        for _ in range(n_req)
+    ]
+    params = SamplingParams(max_new_tokens=mml // 8)
+
+    t0 = time.perf_counter()
+    fleet.generate(prompts, params)   # warm both replicas' programs
+    log(f"[fleet] compile+first run (2 replicas): "
+        f"{time.perf_counter()-t0:.1f}s")
+    spec = FaultSpec(
+        RuntimeError("bench kill"),
+        when=lambda c: (c.get("phase") == "step"
+                        and c.get("replica") == "r0"),
+        at=4,  # a few steps in: r0 holds in-flight decodes
+    )
+    with faults.inject({"serving.replica": spec}):
+        outs = fleet.generate(prompts, params)
+    m = fleet.metrics
+    recovery = m.failover_recovery_s
+    if m.failovers != 1 or recovery is None:
+        raise RuntimeError(
+            f"fleet bench did not exercise a failover (failovers="
+            f"{m.failovers}, recovery={recovery})"
+        )
+    failover_ms = recovery * 1e3
+    n_tokens = sum(len(o.token_ids) for o in outs)
+    log(f"[fleet] {n_req} reqs x 2 replicas x {slots} slots: kill at "
+        f"step 4 -> {m.failover_requests} requests failed over, "
+        f"first recovered token {failover_ms:.1f}ms after detection "
+        f"({n_tokens} tokens served, hedges={m.hedges_started})")
+    print(json.dumps({
+        "metric": "fleet_failover_ms",
+        "value": round(failover_ms, 1),
+        "unit": "ms",
+    }))
+    return failover_ms
+
+
 def bench_resilience(paddle, on_tpu):
     """Failure-recovery time (resilience row): checkpoint a model-sized
     state dict twice, tear the newest write, and measure kill-and-restore
@@ -604,6 +672,7 @@ ROWS = {
     "llama": lambda p, tpu, peak: bench_llama(p, tpu, peak),
     "decode": lambda p, tpu, peak: bench_decode(p, tpu),
     "serving": lambda p, tpu, peak: bench_serving(p, tpu),
+    "fleet": lambda p, tpu, peak: bench_fleet(p, tpu),
     "moe": lambda p, tpu, peak: bench_moe(p, tpu, peak),
     "resnet": lambda p, tpu, peak: bench_resnet(p, tpu),
     "dit": lambda p, tpu, peak: bench_dit(p, tpu),
@@ -703,8 +772,9 @@ def main():
                     pass
             return r.returncode
 
-        for name in ("decode", "serving", "resilience", "analysis",
-                     "observability", "moe", "resnet", "dit"):
+        for name in ("decode", "serving", "fleet", "resilience",
+                     "analysis", "observability", "moe", "resnet",
+                     "dit"):
             try:
                 if name == "moe":
                     # shrink ladder: retry in fresh subprocesses until a

@@ -50,10 +50,12 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
         return procs
     for p in procs:
         p.join()
-    bad = [p.exitcode for p in procs if p.exitcode]
+    bad = {r: p.exitcode for r, p in enumerate(procs) if p.exitcode}
     failures = []
     # one traceback is queued per failed worker; empty()-polling races
-    # the queue feeder, so get with a timeout per expected failure
+    # the queue feeder, so get with a timeout per expected failure. A
+    # worker killed before queuing (segfault, SIGKILL) leaves the queue
+    # short — Empty then means nothing more is coming.
     import queue as _queue
 
     for _ in bad:
@@ -61,11 +63,24 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
             failures.append(err_q.get(timeout=2))
         except _queue.Empty:
             break
-    if failures:
-        rank, tb = failures[0]
-        raise RuntimeError(
-            f"spawn: worker {rank} failed:\n{tb}"
-        )
     if bad:
-        raise RuntimeError(f"spawn: workers exited nonzero: {bad}")
+        # every failure in ONE error: the first worker to die is often
+        # a victim (e.g. of a peer's torn collective), and raising only
+        # its traceback hides the actual culprit
+        parts = [
+            f"worker {rank} failed:\n{tb}"
+            for rank, tb in sorted(failures)
+        ]
+        silent = sorted(set(bad) - {rank for rank, _ in failures})
+        if silent:
+            parts.append(
+                "worker(s) exited nonzero without a traceback: "
+                + ", ".join(
+                    f"rank {r} (exitcode {bad[r]})" for r in silent
+                )
+            )
+        raise RuntimeError(
+            f"spawn: {len(bad)} of {nprocs} worker(s) failed\n"
+            + "\n".join(parts)
+        )
     return None

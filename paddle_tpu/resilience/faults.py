@@ -27,6 +27,15 @@ docs/resilience.md):
     ckpt.write         one checkpoint file write (context: file=)
     serving.step       one engine prefill/decode launch (context:
                        phase=, request_id=/request_ids=)
+    serving.replica    one fleet replica lifecycle event (context:
+                       replica=, phase= "spawn"/"restart"/"step") —
+                       phase="step" fires before the engine step, so an
+                       injected death lands on a step boundary where
+                       the recompute-preemption KV invariant holds
+    fleet.route        one fleet request placement attempt (context:
+                       request_id=, replica=) — routing failures must
+                       degrade to a retry on the next fleet step, never
+                       to a dropped request
     dataloader.worker  one process-worker job (context: worker_id=)
     collective         one watched eager collective (context: op=)
     analysis.pass      one static-analyzer pass invocation (context:

@@ -18,12 +18,22 @@ docs/serving.md for the architecture walkthrough.
 """
 from .adapter import LlamaServingAdapter, build_adapter
 from .engine import Engine, EngineConfig, EngineOverloadedError
+from .fleet import (
+    Fleet,
+    FleetConfig,
+    FleetMetrics,
+    FleetRequest,
+    NoReplicaError,
+)
 from .kv_cache import BlockManager, KVPool
 from .metrics import EngineMetrics
 from .request import Request, RequestOutput, RequestState, SamplingParams
+from .supervisor import ReplicaSupervisor
 
 __all__ = [
     "Engine", "EngineConfig", "EngineOverloadedError", "SamplingParams",
     "Request", "RequestOutput", "RequestState", "BlockManager", "KVPool",
     "EngineMetrics", "LlamaServingAdapter", "build_adapter",
+    "Fleet", "FleetConfig", "FleetMetrics", "FleetRequest",
+    "NoReplicaError", "ReplicaSupervisor",
 ]

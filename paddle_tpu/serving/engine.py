@@ -55,7 +55,13 @@ from ..resilience import faults
 from .adapter import build_adapter
 from .kv_cache import BlockManager, KVPool
 from .metrics import EngineMetrics
-from .request import Request, RequestOutput, RequestState, SamplingParams
+from .request import (
+    Request,
+    RequestOutput,
+    RequestState,
+    SamplingParams,
+    normalize_sampling_params,
+)
 from .sampler import pack_sampling_params, sample_tokens
 
 __all__ = ["Engine", "EngineConfig", "EngineOverloadedError"]
@@ -183,6 +189,10 @@ class Engine:
         self.block_manager = BlockManager(cfg.num_blocks, cfg.page_size)
         self.waiting: collections.deque = collections.deque()
         self.slots: list = [None] * cfg.max_batch_slots
+        # outputs for requests aborted between steps: emitted by the
+        # NEXT step() so drivers blocked on completion (generate(), a
+        # fleet drain) observe the abort instead of waiting forever
+        self._aborted: list = []
         self._admit_counter = 0
         self._key_counter = 0
         self._base_key = jax.random.PRNGKey(cfg.seed)
@@ -355,13 +365,22 @@ class Engine:
     # -- client API ----------------------------------------------------------
     def add_request(self, prompt_token_ids, sampling_params=None,
                     request_id=None):
+        return self.submit(
+            Request(prompt_token_ids, sampling_params, request_id)
+        )
+
+    def submit(self, req):
+        """Admission over a caller-constructed Request — what
+        ``add_request`` wraps. Split out so a router (``serving.fleet``)
+        can keep ONE Request object across replicas: the same object it
+        submits here is what it hands to another replica's
+        :meth:`resume` after a failover, tokens intact."""
         cfg = self.config
         if (cfg.max_waiting is not None
                 and len(self.waiting) >= cfg.max_waiting):
             raise RuntimeError(
                 f"admission queue full ({cfg.max_waiting} waiting)"
             )
-        req = Request(prompt_token_ids, sampling_params, request_id)
         if len(req.prompt_token_ids) >= cfg.max_model_len:
             raise ValueError(
                 f"prompt of {len(req.prompt_token_ids)} tokens leaves no "
@@ -394,24 +413,50 @@ class Engine:
         self.metrics.requests_received += 1
         return req
 
+    def resume(self, req):
+        """Re-enqueue a request whose KV state was lost OUTSIDE the
+        engine's control — a fleet failover hands a dead replica's
+        in-flight Request to a healthy engine here. The externally
+        driven form of recompute preemption: scheduling state is reset,
+        prompt and already-generated tokens are kept, so the next
+        prefill rebuilds the cache over ``prompt + output[:-1]`` and
+        greedy continuation is bit-identical to an uninterrupted run.
+        Joins the HEAD of the queue (it has been waiting longest) and
+        deliberately bypasses ``max_waiting``/shedding: recovered work
+        must not be dropped by admission control."""
+        if req.state is RequestState.FINISHED:
+            raise ValueError(
+                f"cannot resume finished request {req.request_id!r}"
+            )
+        req.block_ids = []
+        req.num_cached = 0
+        req.slot = None
+        req.state = RequestState.WAITING
+        self.waiting.appendleft(req)
+        self.metrics.requests_received += 1
+        return req
+
     def abort(self, request_id):
-        """Drop a request wherever it is; returns True if found."""
+        """Drop a request wherever it is; returns True if found. The
+        abort goes through the normal finish accounting (finish_time,
+        ``requests_finished``, a RequestOutput with
+        ``finish_reason="aborted"`` emitted by the NEXT ``step()``), so
+        drivers blocked on the request's completion — ``generate()``,
+        a fleet drain — observe it instead of waiting forever. Aborts
+        are not failures: nothing lands in the flight ring."""
         for req in list(self.waiting):
             if req.request_id == request_id:
                 self.waiting.remove(req)
-                req.state = RequestState.FINISHED
-                req.finish_reason = "aborted"
+                self._finish(req, "aborted", self._aborted)
                 return True
         for req in self.slots:
             if req is not None and req.request_id == request_id:
-                self._release(req)
-                req.state = RequestState.FINISHED
-                req.finish_reason = "aborted"
+                self._finish(req, "aborted", self._aborted)
                 return True
         return False
 
     def has_unfinished(self):
-        return bool(self.waiting) or any(
+        return bool(self._aborted) or bool(self.waiting) or any(
             r is not None for r in self.slots
         )
 
@@ -421,12 +466,7 @@ class Engine:
         be one SamplingParams for all prompts or a list per prompt.
         Submission respects ``max_waiting`` by feeding the queue as it
         drains instead of raising mid-batch."""
-        if isinstance(sampling_params, (list, tuple)):
-            if len(sampling_params) != len(prompts):
-                raise ValueError("one SamplingParams per prompt required")
-            params = sampling_params
-        else:
-            params = [sampling_params] * len(prompts)
+        params = normalize_sampling_params(prompts, sampling_params)
         cap = self.config.max_waiting
         pending = collections.deque(zip(prompts, params))
         reqs, done = [], {}
@@ -466,6 +506,11 @@ class Engine:
         engine's health snapshot on the way out — the engine is about
         to die, so leave the postmortem."""
         finished: list = []
+        if self._aborted:
+            # requests aborted since the last step finish HERE (see
+            # abort()): their slots/blocks were already released
+            finished.extend(self._aborted)
+            self._aborted.clear()
         try:
             self._expire(finished)
             self._admit(finished)
@@ -498,10 +543,15 @@ class Engine:
         return finished
 
     def health(self):
-        """One-call health snapshot (scrape-endpoint / watchdog probe):
-        ``status`` is "ok", "degraded" (poisoned/expired requests or a
-        tripped comm watchdog), or "overloaded" (admission queue full or
-        KV pressure at the shedding threshold)."""
+        """One-call health snapshot (scrape-endpoint / watchdog probe /
+        fleet router): ``status`` is "ok", "degraded" (poisoned/expired
+        requests or a tripped comm watchdog), or "overloaded"
+        (admission queue full or KV pressure at the shedding
+        threshold). ``status`` keeps its single-string precedence
+        (overloaded beats degraded) for back-compat; ``flags`` carries
+        BOTH signals independently — the fleet router gates admission
+        on it, where overloaded-masking-degraded would hide a sick
+        replica behind a busy one."""
         m, bm, cfg = self.metrics, self.block_manager, self.config
         wd = get_comm_watchdog()
         util = bm.utilization()
@@ -513,14 +563,23 @@ class Engine:
             cfg.kv_shed_threshold is not None
             and util >= cfg.kv_shed_threshold
         )
+        degraded = bool(
+            m.requests_errored or m.requests_timeout
+            or (wd is not None and wd.fired is not None)
+        )
+        overloaded = queue_full or shedding
         status = "ok"
-        if (m.requests_errored or m.requests_timeout
-                or (wd is not None and wd.fired is not None)):
+        if degraded:
             status = "degraded"
-        if queue_full or shedding:
+        if overloaded:
             status = "overloaded"
         return {
             "status": status,
+            "flags": [
+                f for f, on in (
+                    ("degraded", degraded), ("overloaded", overloaded),
+                ) if on
+            ],
             "queue_depth": len(self.waiting),
             "num_running": sum(r is not None for r in self.slots),
             "kv_utilization": util,

@@ -1,0 +1,902 @@
+"""Replicated, self-healing serving fleet.
+
+One ``Engine`` is a single point of failure: a watchdog trip or an
+unhandled ``step()`` error kills every in-flight request with no
+recovery path, and there is no way to reload weights without dropping
+traffic. ``Fleet`` owns N supervised replicas
+(``supervisor.ReplicaSupervisor``) behind the same
+``add_request``/``step``/``generate`` facade as a single engine and
+layers the tail-tolerance playbook of Dean & Barroso's "The Tail at
+Scale" over the primitives the previous PRs built:
+
+  * **Health-gated, least-loaded routing** — new requests go to the
+    live replica with the fewest queued+running requests; a replica
+    whose ``Engine.health()`` reports any ``flags`` entry (degraded /
+    overloaded) or a tripped comm watchdog stops receiving new work.
+    Unroutable moments park requests in a fleet-level pending queue.
+  * **Deterministic crash recovery** — a replica death (unhandled step
+    error, watchdog trip, or an injected ``serving.replica`` fault) is
+    quarantined; every in-flight request is re-enqueued on a healthy
+    replica via ``Engine.resume``, which re-prefills
+    ``prompt + output[:-1]`` — the recompute-preemption path — so
+    greedy outputs are bit-identical to an uninterrupted run. The dead
+    replica restarts in the background under a
+    ``resilience.RetryPolicy`` with a restart budget; exceeding it
+    marks the replica permanently failed and the fleet shrinks.
+  * **Hedged requests** — a request stuck past
+    ``FleetConfig(hedge_after_s=...)`` is dispatched a second time on a
+    different replica; the first completion wins and the loser is
+    aborted (safe because greedy decode is deterministic; sampled
+    requests may win with a different-but-valid continuation — see
+    docs/serving.md for the determinism caveats).
+  * **Rolling drain/restart** — ``drain(replica)`` stops admission and
+    steps the fleet until the replica's in-flight work completes;
+    ``rolling_restart(min_available=k)`` cycles replicas through
+    drain → rebuild (weight reload) → rejoin without dropping requests.
+
+Observability is end-to-end: a pull-time collector view exports
+``paddle_tpu_fleet_*`` series (failovers, hedges won/lost, restarts,
+per-replica status), route/failover/hedge run under spans, and a
+replica death records ``fleet``/``failover`` events and dumps a flight
+recorder postmortem before the restart begins.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import time
+import weakref
+
+from ..observability import MetricFamily, get_registry
+from ..observability import flight as _flight
+from ..observability import register_health_provider, span
+from ..resilience import faults
+from .engine import Engine, EngineConfig, EngineOverloadedError
+from .request import (
+    Request,
+    RequestOutput,
+    RequestState,
+    normalize_sampling_params,
+)
+from .supervisor import ReplicaSupervisor
+
+__all__ = ["Fleet", "FleetConfig", "FleetMetrics", "FleetRequest",
+           "NoReplicaError"]
+
+
+class NoReplicaError(RuntimeError):
+    """Every replica has permanently failed: the fleet cannot serve."""
+
+
+# monotonic fleet ids (same rationale as the engine counter: metric
+# labels and collector names must never alias across fleet lifetimes)
+_fleet_counter = itertools.count(1)
+
+
+class FleetConfig:
+    def __init__(self, num_replicas=2, hedge_after_s=None, max_restarts=2,
+                 restart_policy=None, analysis_check="error"):
+        if num_replicas < 1:
+            raise ValueError(
+                f"num_replicas must be >= 1, got {num_replicas}"
+            )
+        if hedge_after_s is not None and hedge_after_s < 0:
+            raise ValueError(
+                f"hedge_after_s must be >= 0 or None (disabled), got "
+                f"{hedge_after_s}"
+            )
+        if max_restarts < 0:
+            raise ValueError(
+                f"max_restarts must be >= 0, got {max_restarts}"
+            )
+        if analysis_check not in (None, "warn", "error"):
+            raise ValueError(
+                'analysis_check must be None, "warn" or "error", got '
+                f"{analysis_check!r}"
+            )
+        self.num_replicas = int(num_replicas)
+        # hedging deadline: None disables; 0.0 hedges any request not
+        # finished by the step after its dispatch
+        self.hedge_after_s = (
+            None if hedge_after_s is None else float(hedge_after_s)
+        )
+        # crash-restart budget PER REPLICA (rolling restarts are
+        # operator-initiated and do not spend it)
+        self.max_restarts = int(max_restarts)
+        self.restart_policy = restart_policy
+        # decode-loop gate each replica runs at spawn/restart
+        # (supervisor forwards to Engine.check_decode)
+        self.analysis_check = analysis_check
+
+
+class FleetMetrics:
+    """Fleet-level counters (host-side plain attributes, same contract
+    as ``EngineMetrics``: the registry PULLS at scrape time through the
+    fleet's collector view, nothing is written on the hot path)."""
+
+    def __init__(self):
+        self.requests_received = 0
+        self.requests_finished = 0
+        self.failovers = 0            # replica deaths recovered from
+        self.failover_requests = 0    # in-flight requests re-enqueued
+        self.hedges_started = 0
+        self.hedges_won = 0           # hedge dispatch delivered the win
+        self.hedges_lost = 0          # primary beat its hedge
+        self.restarts = 0             # successful rebuilds (crash+rolling)
+        self.replicas_failed = 0      # permanent failures (fleet shrank)
+        self.route_errors = 0
+        # failover recovery timing (the bench [fleet] row): stamped at
+        # death detection and at the first token a re-enqueued request
+        # produces on its new replica
+        self.last_failover_detect_s = None
+        self.last_recovered_token_s = None
+
+    @property
+    def failover_recovery_s(self):
+        """Kill-to-first-recovered-token of the most recent failover,
+        or None."""
+        if (self.last_failover_detect_s is None
+                or self.last_recovered_token_s is None
+                or self.last_recovered_token_s
+                < self.last_failover_detect_s):
+            return None
+        return self.last_recovered_token_s - self.last_failover_detect_s
+
+
+# counter attribute -> exported series name
+_FLEET_COUNTERS = {
+    "requests_received": "paddle_tpu_fleet_requests_received_total",
+    "requests_finished": "paddle_tpu_fleet_requests_finished_total",
+    "failovers": "paddle_tpu_fleet_failovers_total",
+    "failover_requests": "paddle_tpu_fleet_failover_requests_total",
+    "hedges_started": "paddle_tpu_fleet_hedges_started_total",
+    "hedges_won": "paddle_tpu_fleet_hedges_won_total",
+    "hedges_lost": "paddle_tpu_fleet_hedges_lost_total",
+    "restarts": "paddle_tpu_fleet_restarts_total",
+    "replicas_failed": "paddle_tpu_fleet_replicas_failed_total",
+    "route_errors": "paddle_tpu_fleet_route_errors_total",
+}
+
+
+def _register_view(fleet):
+    """Pull-time collector over one fleet (weakref: a collected fleet's
+    view unregisters itself, mirroring EngineMetrics)."""
+    ref = weakref.ref(fleet)
+    name = f"serving.fleet.{fleet.fleet_id}"
+
+    def collect():
+        fl = ref()
+        if fl is None:
+            return None
+        label = {"fleet": fl.fleet_id}
+        m = fl.metrics
+        fams = [
+            MetricFamily(series, "counter").add(getattr(m, attr), label)
+            for attr, series in _FLEET_COUNTERS.items()
+        ]
+        fams.append(MetricFamily(
+            "paddle_tpu_fleet_replicas_total", "gauge",
+        ).add(fl.size(), label))
+        fams.append(MetricFamily(
+            "paddle_tpu_fleet_replicas_healthy", "gauge",
+        ).add(
+            sum(s.status == "healthy" for s in fl.replicas), label,
+        ))
+        fams.append(MetricFamily(
+            "paddle_tpu_fleet_pending_requests", "gauge",
+        ).add(len(fl._pending), label))
+        up = MetricFamily("paddle_tpu_fleet_replica_healthy", "gauge")
+        restarts = MetricFamily(
+            "paddle_tpu_fleet_replica_restarts_total", "counter",
+        )
+        for sup in fl.replicas:
+            rl = {**label, "replica": sup.name}
+            up.add(1.0 if sup.status == "healthy" else 0.0, rl)
+            restarts.add(sup.restarts, rl)
+        fams += [up, restarts]
+        return fams
+
+    get_registry().register_collector(name, collect)
+
+
+class _Dispatch:
+    """One placement of a request on one replica."""
+
+    __slots__ = (
+        "fleet_req", "request", "replica", "kind", "time", "cancelled",
+        "finished",
+    )
+
+    def __init__(self, fleet_req, request, replica, kind):
+        self.fleet_req = fleet_req
+        self.request = request      # the engine-side Request object
+        self.replica = replica      # replica NAME (survives restarts)
+        self.kind = kind            # "primary" | "hedge"
+        self.time = time.perf_counter()
+        self.cancelled = False      # we aborted it (hedge loser)
+        self.finished = False       # its engine emitted an output
+
+
+class FleetRequest:
+    """Client-facing handle for one fleet request. The underlying
+    engine ``Request`` object travels with it across replicas
+    (failover re-submits the SAME object, tokens intact)."""
+
+    def __init__(self, prompt_token_ids, sampling_params, request_id):
+        self.request = Request(
+            prompt_token_ids, sampling_params, request_id
+        )
+        self.dispatches: list = []
+        self.hedged = False
+        self.done = False
+        self.output = None
+
+    @property
+    def request_id(self):
+        return self.request.request_id
+
+    @property
+    def prompt_token_ids(self):
+        return self.request.prompt_token_ids
+
+    @property
+    def sampling_params(self):
+        return self.request.sampling_params
+
+    def __repr__(self):
+        return (
+            f"FleetRequest(id={self.request_id}, done={self.done}, "
+            f"dispatches={len(self.dispatches)})"
+        )
+
+
+class Fleet:
+    """N supervised Engine replicas behind one engine-shaped facade.
+
+        fleet = serving.Fleet(model, serving.EngineConfig(...),
+                              serving.FleetConfig(num_replicas=2))
+        outs = fleet.generate(prompts, serving.SamplingParams(...))
+
+    or stream it like an engine::
+
+        fleet.add_request(ids, params)
+        while fleet.has_unfinished():
+            for out in fleet.step():
+                handle(out)
+    """
+
+    def __init__(self, model, engine_config=None, config=None):
+        self.config = config or FleetConfig()
+        self.engine_config = engine_config
+        self._model = model
+        self.fleet_id = f"{next(_fleet_counter)}"
+        self.metrics = FleetMetrics()
+        self.replicas: list = []
+        for i in range(self.config.num_replicas):
+            sup = self._make_supervisor(f"r{i}")
+            sup.spawn()
+            self.replicas.append(sup)
+        self._pending: collections.deque = collections.deque()
+        self._routes: dict = {}     # engine request id -> _Dispatch
+        self._ready: list = []      # finished client outputs, buffered
+        self._req_counter = itertools.count()
+        # (Request, n_tokens_at_failover) pairs awaiting their first
+        # post-failover token — the recovery-time probe
+        self._recovering: list = []
+        _register_view(self)
+
+        def _probe(ref=weakref.ref(self)):
+            fl = ref()
+            return None if fl is None else fl.health()
+
+        register_health_provider(f"serving.fleet.{self.fleet_id}", _probe)
+
+    def _make_supervisor(self, name):
+        cfg = self.config
+        # the factory closes over the fleet (not a model snapshot) so
+        # rolling_restart(model=...) reloads weights on rebuild
+        return ReplicaSupervisor(
+            name,
+            factory=lambda: Engine(self._model, self.engine_config),
+            restart_policy=cfg.restart_policy,
+            max_restarts=cfg.max_restarts,
+            analysis_check=cfg.analysis_check,
+        )
+
+    # -- introspection -------------------------------------------------------
+    def replica(self, name):
+        for sup in self.replicas:
+            if sup.name == name:
+                return sup
+        raise KeyError(f"no replica {name!r} in fleet {self.fleet_id}")
+
+    def size(self):
+        """Live (non-permanently-failed) replica count."""
+        return sum(s.status != "failed" for s in self.replicas)
+
+    def has_unfinished(self):
+        return bool(self._pending) or bool(self._routes) or bool(
+            self._ready
+        ) or any(
+            s.engine is not None and s.engine.has_unfinished()
+            for s in self.replicas
+        )
+
+    def health(self):
+        """Fleet health snapshot (scrape /healthz provider): "ok" while
+        at least one replica is routable, "degraded" while live-but-
+        unroutable replicas remain, "failed" when the fleet is gone."""
+        statuses = {s.name: s.status for s in self.replicas}
+        routable = sum(s.routable() for s in self.replicas)
+        if routable:
+            status = "ok"
+        elif self.size():
+            status = "degraded"
+        else:
+            status = "failed"
+        return {
+            "status": status,
+            "replicas": statuses,
+            "routable": routable,
+            "pending": len(self._pending),
+            "in_flight": len(self._routes),
+        }
+
+    def snapshot(self):
+        """Fleet counters + per-replica status, one JSON-friendly
+        dict."""
+        m = self.metrics
+        out = {attr: getattr(m, attr) for attr in _FLEET_COUNTERS}
+        out["replicas"] = {
+            s.name: {"status": s.status, "restarts": s.restarts}
+            for s in self.replicas
+        }
+        out["pending"] = len(self._pending)
+        return out
+
+    def _live(self):
+        return [s for s in self.replicas if s.status != "failed"]
+
+    # -- client API ----------------------------------------------------------
+    def add_request(self, prompt_token_ids, sampling_params=None,
+                    request_id=None):
+        if not self._live():
+            raise NoReplicaError(
+                f"fleet {self.fleet_id}: all replicas permanently failed"
+            )
+        if request_id is None:
+            request_id = f"fleet{self.fleet_id}-{next(self._req_counter)}"
+        freq = FleetRequest(prompt_token_ids, sampling_params, request_id)
+        # surface the engine's admission error NOW, not on a later
+        # dispatch attempt deep inside step(). Falls back to the fleet's
+        # engine config while every replica is quarantined (engine is
+        # None) so an over-long prompt can never park unvalidated.
+        cfg = self.engine_config or EngineConfig()
+        for sup in self._live():
+            if sup.engine is not None:
+                cfg = sup.engine.config
+                break
+        if len(freq.prompt_token_ids) >= cfg.max_model_len:
+            raise ValueError(
+                f"prompt of {len(freq.prompt_token_ids)} tokens "
+                f"leaves no room to generate under "
+                f"max_model_len={cfg.max_model_len}"
+            )
+        self.metrics.requests_received += 1
+        self._pending.append(freq)
+        self._dispatch_pending()
+        return freq
+
+    def abort(self, request_id):
+        """Abort a fleet request wherever it is; returns True if
+        found. A dispatched request finishes with
+        ``finish_reason="aborted"`` through its replica's next step."""
+        for freq in list(self._pending):
+            if freq.request_id == request_id:
+                self._pending.remove(freq)
+                if freq.done:
+                    # completed while parked (hedge won after its
+                    # primary died): nothing left to abort
+                    return False
+                # a failover-requeued request may still carry a live
+                # hedge dispatch: cancel it so it doesn't keep
+                # decoding for a dead client, and close the hedge
+                # accounting (resolution is local, not via _collect)
+                for disp in freq.dispatches:
+                    if disp.cancelled or disp.finished:
+                        continue
+                    disp.cancelled = True
+                    sup = self._sup_or_none(disp.replica)
+                    if sup is not None and sup.engine is not None:
+                        sup.engine.abort(disp.request.request_id)
+                if freq.hedged:
+                    self.metrics.hedges_lost += 1
+                self._finish_local(freq, "aborted")
+                return True
+        for d in list(self._routes.values()):
+            if (d.fleet_req.request_id != request_id
+                    or d.kind != "primary" or d.cancelled):
+                continue
+            freq = d.fleet_req
+            if freq.done:
+                return False
+            # abort EVERY live dispatch — a hedge left running could
+            # win the race against the abort and deliver a normal
+            # completion. The primary is NOT marked cancelled (its
+            # aborted output surfaces through _collect as this
+            # request's completion); hedges are, so theirs is
+            # swallowed.
+            found = False
+            for disp in freq.dispatches:
+                if disp.cancelled or disp.finished:
+                    continue
+                sup = self._sup_or_none(disp.replica)
+                if (sup is not None and sup.engine is not None
+                        and sup.engine.abort(disp.request.request_id)):
+                    found = True
+                if disp.kind == "hedge":
+                    disp.cancelled = True
+            return found
+        return False
+
+    def _finish_local(self, freq, reason, error=None):
+        """Finish a fleet request that never reached (or never
+        returned from) an engine — pending abort, unplaceable — with
+        the full completion accounting a routed request gets."""
+        req = freq.request
+        req.error = error
+        req.finish_reason = reason
+        req.state = RequestState.FINISHED
+        req.finish_time = time.perf_counter()
+        freq.done = True
+        freq.output = RequestOutput(req)
+        self.metrics.requests_finished += 1
+        self._ready.append(freq.output)
+
+    def step(self):
+        """One fleet scheduler iteration; returns finished client
+        RequestOutputs (buffered outputs from internal stepping — a
+        drain, a rolling restart — are delivered here too)."""
+        self._step_once()
+        out, self._ready = self._ready, []
+        return out
+
+    def generate(self, prompts, sampling_params=None):
+        """Submit everything, step until done, return outputs in
+        submission order (the Engine.generate contract, fleet-wide)."""
+        params = normalize_sampling_params(prompts, sampling_params)
+        reqs = [
+            self.add_request(p, sp) for p, sp in zip(prompts, params)
+        ]
+        done = {}
+        idle = 0
+        while not all(r.done for r in reqs):
+            if not self._live():
+                raise NoReplicaError(
+                    f"fleet {self.fleet_id}: all replicas failed with "
+                    f"{sum(not r.done for r in reqs)} request(s) "
+                    "unfinished"
+                )
+            before = len(done)
+            for out in self.step():
+                done[out.request_id] = out
+            stepped = any(
+                s.engine is not None and s.engine.has_unfinished()
+                for s in self.replicas
+            )
+            idle = 0 if (len(done) > before or stepped) else idle + 1
+            if idle > 2:
+                if (idle > 50 and self._pending and not self._routes
+                        and self._pick_replica() is None
+                        and not any(s.status == "quarantined"
+                                    for s in self.replicas)):
+                    # nothing in flight, nothing restarting, and the
+                    # pending work has no routable target (e.g. the
+                    # only replica was drained and never resumed):
+                    # no fleet state change can ever unstick this —
+                    # diagnose instead of blocking forever
+                    raise RuntimeError(
+                        f"fleet {self.fleet_id}: {len(self._pending)} "
+                        "request(s) cannot be placed — no routable "
+                        "replica and no restart in flight (replicas: "
+                        + ", ".join(
+                            f"{s.name}={s.status}"
+                            for s in self.replicas
+                        ) + ")"
+                    )
+                # nothing to step and nothing finishing: wait out a
+                # background restart instead of spinning
+                time.sleep(0.005)
+        # flush hedge losers: their aborts finish on the next step of
+        # their replicas, and leaving them in flight would make a
+        # drained fleet report unfinished work
+        guard = 0
+        while (self._routes
+               and all(d.cancelled for d in self._routes.values())
+               and guard < 100):
+            for out in self.step():
+                done[out.request_id] = out
+            guard += 1
+        if self._ready:
+            # late bookkeeping (e.g. every request finished locally
+            # before a step ran): harvest AND clear, or the next
+            # step() would deliver these completions a second time
+            for out in self._ready:
+                done[out.request_id] = out
+            self._ready = []
+        return [done[r.request_id] for r in reqs]
+
+    # -- drain / rolling restart ---------------------------------------------
+    def drain(self, replica, max_steps=10000):
+        """Stop admission to ``replica`` and step the fleet until its
+        in-flight work completes (other replicas keep serving; their
+        finished outputs are buffered for the next ``step()``)."""
+        sup = self.replica(replica) if isinstance(replica, str) else replica
+        if sup.status == "failed":
+            return sup
+        if sup.status == "healthy":
+            sup.status = "draining"
+        for _ in range(max_steps):
+            if sup.engine is None or not sup.engine.has_unfinished():
+                return sup
+            self._step_once()
+        raise RuntimeError(
+            f"drain of replica {sup.name!r} did not converge in "
+            f"{max_steps} steps"
+        )
+
+    def resume_replica(self, replica):
+        """Re-admit a drained replica."""
+        sup = self.replica(replica) if isinstance(replica, str) else replica
+        if sup.status == "draining":
+            sup.status = "healthy"
+        return sup
+
+    def rolling_restart(self, min_available=1, model=None):
+        """Cycle every live replica through drain → rebuild → rejoin —
+        weight reload without dropping requests. ``model`` (optional)
+        replaces the weights used for every subsequent build. At least
+        ``min_available`` replicas stay admitting throughout; rolling
+        rebuilds are operator-initiated and do NOT spend the crash
+        restart budget."""
+        live = self._live()
+        if not 0 <= min_available <= len(live) - 1:
+            raise ValueError(
+                f"min_available={min_available} must leave a replica to "
+                f"restart (fleet has {len(live)} live replica(s))"
+            )
+        if model is not None:
+            self._model = model
+        for sup in list(live):
+            if sup.status not in ("healthy", "draining"):
+                continue  # quarantined replicas are already rebuilding
+            healthy_others = sum(
+                s is not sup and s.status == "healthy"
+                for s in self.replicas
+            )
+            if healthy_others < min_available:
+                raise RuntimeError(
+                    f"cannot restart replica {sup.name!r}: only "
+                    f"{healthy_others} other healthy replica(s), "
+                    f"min_available={min_available}"
+                )
+            self.drain(sup)
+            with span("fleet.restart", replica=sup.name, rolling=True):
+                sup.engine = None
+                try:
+                    sup.spawn()
+                except Exception as e:
+                    sup.last_error = f"{type(e).__name__}: {e}"
+                    sup.status = "failed"
+                    self.metrics.replicas_failed += 1
+                    _flight.record(
+                        "fleet", "rolling-restart-failed",
+                        fleet=self.fleet_id, replica=sup.name,
+                        error=sup.last_error,
+                    )
+                    continue
+            self.metrics.restarts += 1
+            _flight.record(
+                "fleet", "rolling-restart", fleet=self.fleet_id,
+                replica=sup.name,
+            )
+        return self
+
+    # -- scheduler internals -------------------------------------------------
+    def _sup_or_none(self, name):
+        for sup in self.replicas:
+            if sup.name == name:
+                return sup
+        return None
+
+    def _pick_replica(self, exclude=()):
+        candidates = [
+            s for s in self.replicas
+            if s.name not in exclude and s.routable()
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda s: s.load())
+
+    def _step_once(self):
+        self._poll_restarts()
+        # one error-watermark sweep per step: routable() stays
+        # read-only, so health scrapes and repeated _pick_replica
+        # calls can't consume the fresh-degraded admission gate
+        for sup in self.replicas:
+            sup.observe_errors()
+        self._dispatch_pending()
+        if self.config.hedge_after_s is not None:
+            self._maybe_hedge(time.perf_counter())
+        for sup in list(self.replicas):
+            if (sup.status not in ("healthy", "draining")
+                    or sup.engine is None
+                    or not sup.engine.has_unfinished()):
+                continue
+            try:
+                outs = sup.step()
+            except Exception as e:
+                # analysis: allow(broad-except) a replica death is the
+                # event this layer exists to contain: quarantine,
+                # failover, restart — never crash the fleet
+                self._on_replica_death(sup, e)
+                continue
+            for out in outs:
+                self._collect(out)
+        if self._recovering:
+            now = time.perf_counter()
+            for req, n0 in list(self._recovering):
+                if len(req.output_token_ids) > n0:
+                    if self.metrics.last_recovered_token_s is None:
+                        # FIRST recovered token since the failover
+                        # (reset at death detection) — later requests
+                        # must not inflate failover_recovery_s
+                        self.metrics.last_recovered_token_s = now
+                    self._recovering.remove((req, n0))
+                elif req.state is RequestState.FINISHED:
+                    # finished WITHOUT a new token (aborted/expired
+                    # post-failover): not a recovery sample
+                    self._recovering.remove((req, n0))
+
+    def _poll_restarts(self):
+        for sup in self.replicas:
+            if sup.status != "quarantined":
+                continue
+            result = sup.poll()
+            if result == "recovered":
+                self.metrics.restarts += 1
+                _flight.record(
+                    "fleet", "replica-recovered", fleet=self.fleet_id,
+                    replica=sup.name, restarts=sup.restarts,
+                )
+            elif result == "failed":
+                self.metrics.replicas_failed += 1
+                _flight.record(
+                    "fleet", "replica-failed", fleet=self.fleet_id,
+                    replica=sup.name, error=sup.last_error,
+                )
+
+    def _dispatch_pending(self):
+        if not self._pending:
+            return
+        # routable set + loads computed ONCE per sweep (routable()
+        # builds a health snapshot; re-deriving it per pending request
+        # is O(pending x replicas) of waste), then tracked locally as
+        # placements land so least-loaded stays balanced within the
+        # sweep
+        loads = {s: s.load() for s in self.replicas if s.routable()}
+        while self._pending:
+            freq = self._pending[0]
+            if freq.done:
+                # completed while parked (its hedge won after the
+                # primary's replica died): already delivered, must
+                # not be dispatched — and decoded — a second time
+                self._pending.popleft()
+                continue
+            if not self._dispatch_one(freq, loads):
+                return
+            self._pending.popleft()
+
+    def _dispatch_one(self, freq, loads):
+        """Place one pending request; False leaves it queued (no
+        routable replica, admission refused, or an injected
+        ``fleet.route`` fault — routing failures degrade to a retry on
+        the next step, never to a dropped request)."""
+        if not loads:
+            return False
+        target = min(loads, key=loads.get)
+        try:
+            faults.fire(
+                "fleet.route", request_id=freq.request_id,
+                replica=target.name,
+            )
+        except Exception as e:
+            # analysis: allow(broad-except) an injected routing fault
+            # exercises exactly this containment: count it, retry later
+            self.metrics.route_errors += 1
+            _flight.record(
+                "fleet", "route-error", fleet=self.fleet_id,
+                request_id=freq.request_id,
+                error=f"{type(e).__name__}: {e}",
+            )
+            return False
+        with span(
+            "fleet.route", request_id=freq.request_id,
+            replica=target.name,
+        ):
+            try:
+                if freq.request.output_token_ids:
+                    # failed-over mid-generation: KV must be rebuilt
+                    # over prompt + output[:-1] (recompute preemption)
+                    target.engine.resume(freq.request)
+                else:
+                    target.engine.submit(freq.request)
+            except (EngineOverloadedError, RuntimeError):
+                return False  # shed / queue full: stays fleet-pending
+            except ValueError as e:
+                # unplaceable (admission validation raced an engine
+                # rebuild with a stricter config): fail THIS request
+                # instead of wedging the pending queue behind it
+                self._finish_local(
+                    freq, "error", error=f"{type(e).__name__}: {e}",
+                )
+                return True
+        d = _Dispatch(freq, freq.request, target.name, "primary")
+        freq.dispatches.append(d)
+        self._routes[freq.request.request_id] = d
+        loads[target] += 1
+        return True
+
+    def _maybe_hedge(self, now):
+        deadline = self.config.hedge_after_s
+        for d in list(self._routes.values()):
+            freq = d.fleet_req
+            if (freq.done or freq.hedged or d.kind != "primary"
+                    or d.cancelled or d.finished
+                    or now - d.time <= deadline):
+                continue
+            target = self._pick_replica(exclude={d.replica})
+            if target is None:
+                continue
+            hreq = Request(
+                freq.prompt_token_ids, freq.sampling_params,
+                request_id=f"{freq.request_id}::hedge",
+            )
+            with span(
+                "fleet.hedge", request_id=freq.request_id,
+                replica=target.name,
+            ):
+                try:
+                    target.engine.submit(hreq)
+                except (EngineOverloadedError, RuntimeError):
+                    continue  # no capacity for a hedge right now
+            freq.hedged = True
+            hd = _Dispatch(freq, hreq, target.name, "hedge")
+            freq.dispatches.append(hd)
+            self._routes[hreq.request_id] = hd
+            self.metrics.hedges_started += 1
+            _flight.record(
+                "fleet", "hedge", fleet=self.fleet_id,
+                request_id=freq.request_id, replica=target.name,
+            )
+
+    def _collect(self, out):
+        d = self._routes.pop(out.request_id, None)
+        if d is None:
+            return  # not fleet-managed
+        d.finished = True
+        freq = d.fleet_req
+        if freq.done or d.cancelled:
+            return  # hedge loser / abort echo; resolution already done
+        freq.done = True
+        # hedge winners carry the engine-side "<id>::hedge" id; clients
+        # see their own id regardless of which dispatch won
+        out.request_id = freq.request_id
+        freq.output = out
+        if freq.hedged:
+            if d.kind == "hedge":
+                self.metrics.hedges_won += 1
+            else:
+                self.metrics.hedges_lost += 1
+        self.metrics.requests_finished += 1
+        for other in freq.dispatches:
+            if other is d or other.finished or other.cancelled:
+                continue
+            other.cancelled = True
+            sup = self._sup_or_none(other.replica)
+            if sup is not None and sup.engine is not None:
+                sup.engine.abort(other.request.request_id)
+        self._ready.append(out)
+
+    # -- failover ------------------------------------------------------------
+    def _on_replica_death(self, sup, exc):
+        """Quarantine a dead replica, re-enqueue its in-flight work on
+        healthy replicas (deterministic re-prefill), leave a
+        postmortem, and start the background restart."""
+        detect = time.perf_counter()
+        m = self.metrics
+        m.failovers += 1
+        m.last_failover_detect_s = detect
+        m.last_recovered_token_s = None
+        engine = sup.engine
+        error = f"{type(exc).__name__}: {exc}"
+        _flight.record(
+            "fleet", "replica-death", fleet=self.fleet_id,
+            replica=sup.name, error=error,
+        )
+        try:
+            probe = engine.health()
+        except Exception as he:
+            # analysis: allow(broad-except) the engine is torn by
+            # definition here; the postmortem records that instead
+            probe = {"error": f"health() failed: {he!r}"}
+        sup.quarantine(exc)
+        with span("fleet.failover", replica=sup.name, error=error):
+            # slot requests resume via appendleft on the survivor, so
+            # process them YOUNGEST-first: the chain of appendlefts
+            # leaves the oldest work at the head of its new queue.
+            # The dead replica's local waiting queue follows in its
+            # own (oldest-first) order — those re-place via tail
+            # submit, which preserves processing order.
+            inflight = sorted(
+                (r for r in engine.slots if r is not None),
+                key=lambda r: r.admit_seq, reverse=True,
+            ) + list(engine.waiting)
+            # requests the dying engine had already detached from its
+            # scheduler — aborted between steps (``engine._aborted``)
+            # or finished during the fatal step itself — still hold
+            # live dispatch records; deliver their completions now so
+            # no generate()/drain() waiter hangs on a dead route
+            for d in list(self._routes.values()):
+                if d.replica != sup.name:
+                    continue
+                req = d.request
+                if req.state is RequestState.FINISHED:
+                    self._collect(RequestOutput(req))
+                elif req not in inflight:
+                    inflight.append(req)  # limbo: fail it over too
+            for req in inflight:
+                d = self._routes.pop(req.request_id, None)
+                if d is None or d.fleet_req.done:
+                    continue
+                freq = d.fleet_req
+                if d.cancelled:
+                    continue  # an already-aborted hedge loser died with it
+                if d.kind == "hedge":
+                    # the hedge died, the primary is still running:
+                    # drop the hedge rather than failing it over
+                    # (resolution is counted at the primary's win)
+                    d.finished = True
+                    continue
+                self._recovering.append(
+                    (req, len(req.output_token_ids))
+                )
+                m.failover_requests += 1
+                _flight.record(
+                    "fleet", "failover", fleet=self.fleet_id,
+                    replica=sup.name, request_id=freq.request_id,
+                    tokens_kept=len(req.output_token_ids),
+                )
+                self._pending.append(freq)
+                # drop the dead dispatch record; _dispatch_pending
+                # re-places the request (resume path: tokens kept)
+                freq.dispatches.remove(d)
+        _flight.dump(
+            f"replica-death:{sup.name}",
+            probes={
+                f"serving.replica.{sup.name}": probe,
+                f"serving.fleet.{self.fleet_id}": self.snapshot(),
+            },
+        )
+        if sup.start_restart():
+            _flight.record(
+                "fleet", "restart-started", fleet=self.fleet_id,
+                replica=sup.name, attempt=sup.restarts,
+            )
+        else:
+            m.replicas_failed += 1
+            _flight.record(
+                "fleet", "replica-failed", fleet=self.fleet_id,
+                replica=sup.name, error="restart budget exhausted",
+            )
+        self._dispatch_pending()

@@ -14,7 +14,19 @@ import enum
 import itertools
 import time
 
-__all__ = ["RequestState", "SamplingParams", "Request", "RequestOutput"]
+__all__ = ["RequestState", "SamplingParams", "Request", "RequestOutput",
+           "normalize_sampling_params"]
+
+
+def normalize_sampling_params(prompts, sampling_params):
+    """One params-per-prompt list from either a single SamplingParams
+    (broadcast) or a per-prompt list — the shared ``generate(prompts,
+    sampling_params)`` contract of ``Engine`` and ``Fleet``."""
+    if isinstance(sampling_params, (list, tuple)):
+        if len(sampling_params) != len(prompts):
+            raise ValueError("one SamplingParams per prompt required")
+        return list(sampling_params)
+    return [sampling_params] * len(prompts)
 
 
 class RequestState(enum.Enum):

@@ -1,0 +1,424 @@
+"""paddle_tpu.serving.fleet: supervised replicas, deterministic
+failover, hedging, rolling drain/restart.
+
+The acceptance criteria of the fleet layer, asserted directly on a
+deterministic CPU suite (tiny shared Llama, seeded workloads):
+
+  * kill-mid-decode failover returns greedy outputs token-for-token
+    identical to a single uninterrupted Engine run, with ZERO decode
+    recompiles on the surviving replica and ``fleet_failovers_total``
+    == 1 in the process-wide metrics snapshot;
+  * a replica death leaves a flight-recorder postmortem containing the
+    failover events;
+  * hedge winner/loser accounting closes (started == won + lost);
+  * drain + rolling restart cycle replicas without dropping requests,
+    honoring ``min_available``;
+  * exhausting the restart budget marks a replica permanently failed
+    and shrinks the fleet, which keeps serving on the survivors.
+
+Compile-lean: one module-scope model, one shared engine config with a
+single prefill bucket, and fleets sized 2 — every Engine build costs
+one decode + one prefill trace.
+"""
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.resilience import FaultSpec, faults
+from paddle_tpu.serving import (
+    Engine,
+    EngineConfig,
+    Fleet,
+    FleetConfig,
+    SamplingParams,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def _engine_config(**kw):
+    base = dict(
+        max_batch_slots=4, max_model_len=32, page_size=4,
+        prefill_buckets=[32],
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def oracle(model):
+    """Single uninterrupted engine — the bit-parity reference."""
+    return Engine(model, _engine_config())
+
+
+def _wait_replica_settled(fleet, name, timeout=20.0):
+    """Step the fleet until a quarantined replica's background restart
+    resolves (healthy or failed)."""
+    sup = fleet.replica(name)
+    deadline = time.time() + timeout
+    while sup.status == "quarantined" and time.time() < deadline:
+        sup.join_restart(0.5)
+        fleet.step()
+    return sup.status
+
+
+class TestFailover:
+    """The headline acceptance test: kill one replica mid-decode."""
+
+    def test_kill_mid_decode_failover(
+        self, model, oracle, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        rng = np.random.default_rng(42)
+        prompts = [
+            rng.integers(1, 128, int(n)).tolist()
+            for n in rng.choice([3, 5, 7, 9], 16)
+        ]
+        params = SamplingParams(max_new_tokens=8)
+        fleet = Fleet(model, _engine_config(), FleetConfig(
+            num_replicas=2, analysis_check=None,
+        ))
+        # warm both replicas' programs so the kill lands mid-decode of
+        # a steady-state fleet (and the compile probe below is strict)
+        fleet.generate(prompts, params)
+        for name in ("r0", "r1"):
+            assert fleet.replica(name).engine.metrics.decode_compiles == 1
+
+        spec = FaultSpec(
+            RuntimeError("replica torn"),
+            when=lambda c: (c.get("phase") == "step"
+                            and c.get("replica") == "r0"),
+            at=4,  # a few steps in: r0 has running requests w/ tokens
+        )
+        with faults.inject({"serving.replica": spec}) as inj:
+            outs = fleet.generate(prompts, params)
+        assert inj.fired == {"serving.replica": 1}
+
+        # token-for-token identical to the uninterrupted single engine
+        ref = oracle.generate(prompts, params)
+        for got, want in zip(outs, ref):
+            assert got.token_ids == want.token_ids
+            assert got.finish_reason == want.finish_reason
+
+        m = fleet.metrics
+        assert m.failovers == 1
+        assert m.failover_requests >= 1
+        assert m.failover_recovery_s is not None
+        assert m.failover_recovery_s >= 0.0
+        # the survivor's decode program never retraced (the counter is
+        # bumped INSIDE the traced body): failover re-prefills resumed
+        # requests, it does not change the decode shape
+        assert fleet.replica("r1").engine.metrics.decode_compiles == 1
+        # process-wide metrics snapshot carries the failover counter
+        from paddle_tpu.observability import get_registry
+
+        snap = get_registry().snapshot()
+        key = (
+            "paddle_tpu_fleet_failovers_total"
+            f"{{fleet={fleet.fleet_id}}}"
+        )
+        assert snap[key] == 1
+
+        # postmortem: the replica death dumped the flight ring, and the
+        # ring contains the failover events for the re-enqueued work
+        from paddle_tpu.observability import find_dumps
+
+        dumps = find_dumps(str(tmp_path))
+        assert dumps, "replica death left no postmortem"
+        payload = json.loads(open(dumps[0]).read())
+        assert payload["reason"] == "replica-death:r0"
+        fleet_events = [
+            ev for ev in payload["events"]
+            if ev.get("category") == "fleet"
+        ]
+        assert any(ev["name"] == "replica-death" for ev in fleet_events)
+        failover_events = [
+            ev for ev in fleet_events if ev["name"] == "failover"
+        ]
+        assert len(failover_events) == m.failover_requests
+        assert any(
+            f"serving.replica.r0" in k for k in payload["probes"]
+        )
+
+        # the killed replica restarted in the background and rejoined
+        assert _wait_replica_settled(fleet, "r0") == "healthy"
+        assert fleet.replica("r0").restarts == 1
+        assert fleet.size() == 2
+        # and the recovered fleet still serves
+        again = fleet.generate(prompts[:4], params)
+        for got, want in zip(again, ref[:4]):
+            assert got.token_ids == want.token_ids
+
+
+class TestHedging:
+    def test_hedge_winner_loser_accounting(self, model, oracle):
+        fleet = Fleet(
+            model, _engine_config(max_batch_slots=2),
+            FleetConfig(num_replicas=2, hedge_after_s=0.0,
+                        analysis_check=None),
+        )
+        prompts = [[1, 2, 3], [4, 5, 6, 7]]
+        params = SamplingParams(max_new_tokens=6)
+        outs = fleet.generate(prompts, params)
+        m = fleet.metrics
+        assert m.hedges_started == 2
+        assert m.hedges_won + m.hedges_lost == m.hedges_started
+        # both requests landed on different replicas, so one hedge runs
+        # on the replica stepped first and wins the race
+        assert m.hedges_won >= 1
+        # greedy determinism: whichever dispatch won, the tokens match
+        # the single-engine run
+        ref = oracle.generate(prompts, params)
+        for got, want in zip(outs, ref):
+            assert got.token_ids == want.token_ids
+        # losers were aborted, not leaked: every engine drained, blocks
+        # freed, no in-flight fleet state left behind
+        assert not fleet.has_unfinished()
+        for sup in fleet.replicas:
+            assert sup.engine.block_manager.num_used == 0
+
+    def test_hedging_disabled_by_default(self, model):
+        fleet = Fleet(model, _engine_config(), FleetConfig(
+            num_replicas=2, analysis_check=None,
+        ))
+        fleet.generate([[1, 2]], SamplingParams(max_new_tokens=2))
+        assert fleet.metrics.hedges_started == 0
+
+
+class TestDrainRollingRestart:
+    def test_drain_stops_admission_and_waits_out_work(self, model):
+        fleet = Fleet(model, _engine_config(), FleetConfig(
+            num_replicas=2, analysis_check=None,
+        ))
+        params = SamplingParams(max_new_tokens=6)
+        reqs = [
+            fleet.add_request([i + 1, i + 2], params) for i in range(4)
+        ]
+        fleet.step()
+        fleet.drain("r0")
+        r0 = fleet.replica("r0")
+        assert r0.status == "draining"
+        assert not r0.engine.has_unfinished()
+        # a draining replica receives no new work
+        nr = fleet.add_request([9, 9], params)
+        fleet.step()
+        assert fleet._routes[nr.request_id].replica == "r1"
+        fleet.resume_replica("r0")
+        assert r0.status == "healthy"
+        while fleet.has_unfinished():
+            fleet.step()
+        assert all(r.done for r in reqs) and nr.done
+
+    def test_rolling_restart_min_available(self, model, oracle):
+        fleet = Fleet(model, _engine_config(), FleetConfig(
+            num_replicas=2, analysis_check=None,
+        ))
+        params = SamplingParams(max_new_tokens=6)
+        prompts = [[i + 1, i + 2, i + 3] for i in range(6)]
+        reqs = [fleet.add_request(p, params) for p in prompts]
+        fleet.step()
+        # min_available == live count leaves nothing to restart
+        with pytest.raises(ValueError, match="min_available"):
+            fleet.rolling_restart(min_available=2)
+        old_ids = {
+            s.name: s.engine.engine_id for s in fleet.replicas
+        }
+        fleet.rolling_restart(min_available=1)
+        new_ids = {
+            s.name: s.engine.engine_id for s in fleet.replicas
+        }
+        # every replica was rebuilt (weight-reload hook) ...
+        assert all(old_ids[k] != new_ids[k] for k in old_ids)
+        assert all(s.status == "healthy" for s in fleet.replicas)
+        # ... without spending the crash-restart budget
+        assert all(s.restarts == 0 for s in fleet.replicas)
+        assert fleet.metrics.restarts == 2
+        # ... and without dropping a single request
+        while fleet.has_unfinished():
+            fleet.step()
+        assert all(r.done for r in reqs)
+        ref = oracle.generate(prompts, params)
+        for r, want in zip(reqs, ref):
+            assert r.output.token_ids == want.token_ids
+
+
+class TestRestartBudget:
+    def test_budget_exhaustion_shrinks_fleet(self, model):
+        fleet = Fleet(model, _engine_config(), FleetConfig(
+            num_replicas=2, analysis_check=None,
+        ))
+        params = SamplingParams(max_new_tokens=4)
+        specs = {"serving.replica": [
+            FaultSpec(
+                RuntimeError("boom"),
+                when=lambda c: (c.get("phase") == "step"
+                                and c.get("replica") == "r0"),
+                at=2,
+            ),
+            # every restart attempt fails -> the RetryPolicy exhausts
+            # and the replica is marked permanently failed
+            FaultSpec(
+                OSError("no host"),
+                when=lambda c: c.get("phase") == "restart",
+            ),
+        ]}
+        with faults.inject(specs) as inj:
+            outs = fleet.generate([[1, 2, 3]] * 6, params)
+            status = _wait_replica_settled(fleet, "r0")
+        assert status == "failed"
+        assert inj.fired["serving.replica"] >= 2  # kill + restarts
+        # requests still completed (failover onto the survivor)
+        assert all(o.finish_reason == "length" for o in outs)
+        assert fleet.size() == 1
+        assert fleet.metrics.replicas_failed == 1
+        assert fleet.metrics.failovers == 1
+        # the shrunken fleet keeps serving
+        more = fleet.generate([[7, 8]] * 2, params)
+        assert [o.finish_reason for o in more] == ["length"] * 2
+        # ... and reports degraded-but-alive health
+        h = fleet.health()
+        assert h["status"] == "ok" and h["replicas"]["r0"] == "failed"
+
+    def test_zero_budget_fails_immediately(self, model):
+        fleet = Fleet(model, _engine_config(), FleetConfig(
+            num_replicas=2, max_restarts=0, analysis_check=None,
+        ))
+        spec = FaultSpec(
+            RuntimeError("boom"),
+            when=lambda c: (c.get("phase") == "step"
+                            and c.get("replica") == "r1"),
+            at=1,
+        )
+        with faults.inject({"serving.replica": spec}):
+            fleet.generate([[1, 2]] * 4, SamplingParams(max_new_tokens=2))
+        assert fleet.replica("r1").status == "failed"
+        assert fleet.size() == 1
+
+
+class TestFleetAPI:
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="num_replicas"):
+            FleetConfig(num_replicas=0)
+        with pytest.raises(ValueError, match="hedge_after_s"):
+            FleetConfig(hedge_after_s=-1.0)
+        with pytest.raises(ValueError, match="max_restarts"):
+            FleetConfig(max_restarts=-1)
+        with pytest.raises(ValueError, match="analysis_check"):
+            FleetConfig(analysis_check="maybe")
+
+    def test_spawn_gate_runs_check_decode(self, model):
+        # default FleetConfig gates every replica spawn through
+        # check_decode; the engine decode step is clean, so the fleet
+        # comes up (the rejecting side of the gate is pinned by
+        # test_analysis over the same machinery)
+        fleet = Fleet(model, _engine_config(), FleetConfig(
+            num_replicas=1,
+        ))
+        assert fleet.config.analysis_check == "error"
+        assert fleet.replica("r0").status == "healthy"
+
+    def test_route_fault_degrades_to_retry(self, model):
+        fleet = Fleet(model, _engine_config(), FleetConfig(
+            num_replicas=2, analysis_check=None,
+        ))
+        spec = FaultSpec(RuntimeError("router glitch"), at=1)
+        with faults.inject({"fleet.route": spec}) as inj:
+            outs = fleet.generate(
+                [[1, 2, 3]], SamplingParams(max_new_tokens=3)
+            )
+        assert inj.fired == {"fleet.route": 1}
+        assert fleet.metrics.route_errors == 1
+        assert outs[0].finish_reason == "length"
+
+    def test_abort_pending_and_dispatched(self, model):
+        fleet = Fleet(
+            model, _engine_config(max_batch_slots=1, max_waiting=1),
+            FleetConfig(num_replicas=1, analysis_check=None),
+        )
+        params = SamplingParams(max_new_tokens=8)
+        r1 = fleet.add_request([1, 2, 3], params)
+        fleet.step()                           # r1 running
+        r2 = fleet.add_request([4, 5], params)  # engine queue full ...
+        r3 = fleet.add_request([6, 7], params)  # ... r3 stays pending
+        assert fleet._routes.get(r3.request_id) is None
+        assert fleet.abort(r3.request_id)      # fleet-pending abort
+        assert r3.done and r3.output.finish_reason == "aborted"
+        assert fleet.abort(r1.request_id)      # running on a replica
+        while fleet.has_unfinished():
+            fleet.step()
+        assert r1.done and r1.output.finish_reason == "aborted"
+        assert r2.done and r2.output.finish_reason == "length"
+        assert not fleet.abort("nope")
+
+    def test_prompt_too_long_raises_at_add(self, model):
+        fleet = Fleet(model, _engine_config(), FleetConfig(
+            num_replicas=1, analysis_check=None,
+        ))
+        with pytest.raises(ValueError, match="no room"):
+            fleet.add_request(list(range(1, 33)))
+
+    def test_degraded_history_does_not_unroute(self, model):
+        """Engine 'degraded' is cumulative (errored/timeout counters
+        never reset): one expired request gates admission for ONE
+        routable() check, not forever — else a single TTL expiry would
+        wedge a one-replica fleet permanently."""
+        fleet = Fleet(model, _engine_config(), FleetConfig(
+            num_replicas=1, analysis_check=None,
+        ))
+        sup = fleet.replica("r0")
+        out = fleet.generate(
+            [[1, 2, 3]],
+            SamplingParams(max_new_tokens=4, ttl_s=0.0),
+        )[0]
+        assert out.finish_reason == "timeout"
+        assert "degraded" in sup.engine.health()["flags"]
+        sup.observe_errors()             # the per-step watermark sweep
+        assert sup.routable() is False   # the NEW error gates this step
+        assert sup.routable() is False   # ... and reads don't consume it
+        sup.observe_errors()             # next step: no new errors
+        assert sup.routable() is True    # history alone does not gate
+        more = fleet.generate([[4, 5]], SamplingParams(max_new_tokens=2))
+        assert more[0].finish_reason == "length"
+
+    def test_abort_then_replica_death_delivers_abort(self, model):
+        """A request aborted between steps waits in the engine's
+        internal aborted list for the NEXT step to emit its output; if
+        the replica dies before that step, the failover sweep must
+        deliver the aborted completion instead of leaving a dead route
+        that hangs generate()/drain() forever."""
+        fleet = Fleet(model, _engine_config(), FleetConfig(
+            num_replicas=2, max_restarts=0, analysis_check=None,
+        ))
+        params = SamplingParams(max_new_tokens=8)
+        reqs = [fleet.add_request([1, 2, 3 + i], params) for i in range(4)]
+        fleet.step()  # everything prefilled across both replicas
+        victim = next(
+            r for r in reqs
+            if fleet._routes[r.request_id].replica == "r0"
+        )
+        assert fleet.abort(victim.request_id)
+        spec = FaultSpec(
+            RuntimeError("boom"),
+            when=lambda c: (c.get("phase") == "step"
+                            and c.get("replica") == "r0"),
+        )
+        with faults.inject({"serving.replica": spec}):
+            for _ in range(300):
+                if all(r.done for r in reqs):
+                    break
+                fleet.step()
+        assert all(r.done for r in reqs), "abort+death left a dead route"
+        assert victim.output.finish_reason == "aborted"
+        for r in reqs:
+            if r is not victim:
+                assert r.output.finish_reason == "length"
+        assert fleet.metrics.failovers == 1

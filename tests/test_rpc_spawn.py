@@ -126,3 +126,22 @@ class TestSpawn:
 
         with pytest.raises(Exception, match="worker died|exit"):
             spawn(bad, nprocs=2)
+
+    def test_spawn_aggregates_all_failures(self):
+        """Every failed worker's traceback lands in ONE raised error —
+        the first death is often a victim of a sibling's failure, and
+        raising only its traceback hides the culprit."""
+        from paddle_tpu.distributed import spawn
+
+        def bad():
+            import os
+
+            rank = os.environ["PADDLE_TRAINER_ID"]
+            raise RuntimeError(f"rank-{rank}-distinct-failure")
+
+        with pytest.raises(RuntimeError) as exc_info:
+            spawn(bad, nprocs=2)
+        msg = str(exc_info.value)
+        assert "2 of 2 worker(s) failed" in msg
+        assert "rank-0-distinct-failure" in msg
+        assert "rank-1-distinct-failure" in msg

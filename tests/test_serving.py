@@ -292,14 +292,22 @@ class TestEngineAPI:
         engine.step()  # both running
         assert engine.abort(r1.request_id)
         assert r1.finish_reason == "aborted"
+        assert r1.finish_time is not None
         assert engine.block_manager.num_used > 0  # r2 still holds blocks
         assert not engine.abort(12345)
+        done = {}
         while engine.has_unfinished():
-            engine.step()
+            for out in engine.step():
+                done[out.request_id] = out
+        # the abort produced a RequestOutput from the NEXT step — a
+        # driver waiting on r1 (generate, a fleet drain) unblocks
+        assert done[r1.request_id].finish_reason == "aborted"
+        assert done[r1.request_id].latency is not None
         assert engine.block_manager.num_used == 0
         assert r2.state is serving.RequestState.FINISHED
         snap = engine.metrics.snapshot()
-        assert snap["requests_finished"] == base["requests_finished"] + 1
+        # BOTH requests finished: the abort counts
+        assert snap["requests_finished"] == base["requests_finished"] + 2
         # r2: 2 prompt tokens prefilled, first token at prefill, 2 decoded
         assert snap["prefill_tokens"] >= base["prefill_tokens"] + 2
         assert snap["mean_ttft_s"] > 0
@@ -380,6 +388,7 @@ class TestGracefulDegradation:
     def test_health_starts_ok(self, small_engine):
         h = small_engine.health()
         assert h["status"] == "ok"
+        assert h["flags"] == []
         assert h["queue_depth"] == 0 and h["num_running"] == 0
         assert h["watchdog"] == {"enabled": False, "fired": None}
 
@@ -401,6 +410,7 @@ class TestGracefulDegradation:
         assert engine.block_manager.num_used == 0
         assert engine.metrics.requests_errored == 1
         assert engine.health()["status"] == "degraded"
+        assert "degraded" in engine.health()["flags"]
         assert "bad weights" in engine.metrics.last_error
 
     def test_poison_decode_bisected_out(self, model, small_engine):
@@ -483,7 +493,15 @@ class TestGracefulDegradation:
             with pytest.raises(EngineOverloadedError, match="shed"):
                 engine.add_request([1, 2], params)
             assert engine.metrics.requests_shed == 1
-            assert engine.health()["status"] == "overloaded"
+            h = engine.health()
+            # status precedence keeps the single string (overloaded
+            # masks degraded) — flags carries BOTH for the fleet router
+            assert h["status"] == "overloaded"
+            assert "overloaded" in h["flags"]
+            if engine.metrics.requests_errored:
+                # module-scope engine: earlier poison tests left it
+                # degraded — overloaded must not mask that in flags
+                assert "degraded" in h["flags"]
             out = _drain(engine)
             assert len(out) == len(reqs)
             # pressure released: admission works again
