@@ -31,8 +31,13 @@ _NOT_TO_STATIC = set()
 # first compile onto a dead one's warm signature — a false
 # retrace-after-warmup alarm
 import itertools as _itertools  # noqa: E402
+import re as _re  # noqa: E402
 
 _instance_tokens = _itertools.count(1)
+
+# default object.__repr__ shape: "<pkg.Cls object at 0x7f...>" — a
+# process-local address that must never reach a cross-process cache key
+_ADDR_REPR = _re.compile(r" at 0x[0-9a-fA-F]+>")
 
 
 def not_to_static(fn):
@@ -159,7 +164,7 @@ class StaticFunction:
     fwd/bwd partial-program pair (jit/dy2static/partial_program.py).
     """
 
-    def __init__(self, function, layer=None, check=None):
+    def __init__(self, function, layer=None, check=None, cache=None):
         self._function = function
         self._layer = layer
         if layer is not None:
@@ -179,6 +184,15 @@ class StaticFunction:
         self._check = check
         self._checked_sigs = set()
         self._instance_tok = next(_instance_tokens)
+        # persistent compile cache (paddle_tpu.compilecache): eval-mode
+        # calls run through AOT executables keyed on the function's
+        # bytecode fingerprint + abstract signature, loaded from disk
+        # by a later process with zero tracing. None disables.
+        self._cache_spec = cache
+        self._cc = None            # resolved lazily
+        self._code_fp = None
+        self._aot = {}             # sig -> (compiled, user out_tree)
+        self._warned_unstable = False
 
     def _run_check(self, args, kwargs, sig):
         """``to_static(check=...)`` choke point: on the first call per
@@ -210,9 +224,16 @@ class StaticFunction:
         outer = self
         self._built_nan = _nan_check_enabled()
 
-        def core(param_arrays, buffer_arrays, key, in_flat, in_meta):
+        def core(param_arrays, buffer_arrays, key, in_flat, in_meta,
+                 mode=None):
             """in_flat: flat tensor-slot arrays; in_meta: (treedef, flat
-            template with None at tensor slots, slot indices) — static."""
+            template with None at tensor slots, slot indices) — static.
+            ``mode`` (static) carries the layer's train/eval flag into
+            the trace-cache key: the flag shapes the traced program
+            (dropout, batchnorm) but is invisible to the abstract
+            signature, and jax caches lowerings per signature — without
+            it, lowering after a train()/eval() flip would silently
+            reuse the other mode's trace."""
             jit_events.mark_traced()  # compile/retrace event log
             treedef, template, slots = in_meta
             flat = list(template)
@@ -244,7 +265,7 @@ class StaticFunction:
                 _swap_payloads(buffers, old_b)
             return out_arrays, new_buf, new_key, nan_flags
 
-        return jax.jit(core, static_argnames=("in_meta",))
+        return jax.jit(core, static_argnames=("in_meta", "mode"))
 
     @staticmethod
     def _is_data(x):
@@ -267,6 +288,108 @@ class StaticFunction:
             None if self._is_data(x) else x for x in flat
         )
         return arrays, (treedef, template, slots)
+
+    # -- persistent compile cache (paddle_tpu.compilecache) ------------------
+    def _call_aot(self, sig, param_arrays, buf_arrays, key, in_arrays,
+                  in_meta):
+        """Run this signature through an AOT executable: loaded from
+        the persistent compile cache (zero traces — recorded as an
+        ``aot-hit`` event) or compiled once via ``self._core.lower``
+        (the probes fire normally) and serialized for the next process.
+        Returns ``(outs, new_buf, nan_flags)``; ``self._out_tree`` is
+        restored from the artifact so unflattening works without the
+        trace that normally populates it."""
+        # the layer's train/eval flag shapes the traced program (dropout,
+        # batchnorm) but is invisible to the abstract signature — key on
+        # it, or a train()-mode call could replay an eval-mode executable
+        # (in-process or from a previous process's artifact)
+        mode = getattr(self._layer, "training", None)
+        entry = self._aot.get((sig, mode))
+        if entry is None:
+            entry = self._aot_load_or_compile(
+                sig, param_arrays, buf_arrays, key, in_arrays, in_meta,
+                mode,
+            )
+            self._aot[(sig, mode)] = entry
+        exe, out_tree = entry
+        self._out_tree = out_tree
+        outs, new_buf, _, nflags = exe(
+            param_arrays, buf_arrays, key, in_arrays
+        )
+        return outs, new_buf, nflags
+
+    def _aot_load_or_compile(self, sig, param_arrays, buf_arrays, key,
+                             in_arrays, in_meta, mode=None):
+        import pickle
+
+        from .. import compilecache as cc_mod
+
+        if self._cc is None:
+            self._cc = cc_mod.resolve(self._cache_spec)
+        cc = self._cc
+        if self._code_fp is None:
+            self._code_fp = cc_mod.code_fingerprint(self._function) or ""
+        name = getattr(self._function, "__name__", "staged_fn")
+        cache_name = f"to_static.{name}"
+        # disk key: bytecode fingerprint + abstract input signature +
+        # the static input template — NOT the instance token (a fresh
+        # process's instance must hit the previous process's artifact).
+        # Caveat (docs/compilecache.md): the fingerprint covers this
+        # function's own bytecode, not its callees' — see
+        # compilecache.code_fingerprint.
+        meta_token = repr(in_meta)
+        # a static arg with a default object repr embeds a process-local
+        # address: the key would be unique per process — every restart
+        # a miss plus a freshly-stored orphan artifact. Such signatures
+        # compile in-memory only.
+        disk_ok = bool(self._code_fp) and not _ADDR_REPR.search(
+            meta_token
+        )
+        if self._code_fp and not disk_ok and not self._warned_unstable:
+            self._warned_unstable = True
+            import sys
+
+            sys.stderr.write(
+                f"[compilecache] {cache_name}: a static argument has no "
+                "stable repr (address-bearing); this signature is "
+                "compiled per process, not disk-cached\n"
+            )
+        sig_str = (
+            f"to_static:{self._code_fp}:"
+            + cc_mod.signature_str((
+                cc_mod.abstractify(param_arrays),
+                cc_mod.abstractify(buf_arrays),
+                cc_mod.abstractify(key),
+                cc_mod.abstractify(in_arrays),
+            ))
+            + f":meta={meta_token}:mode={mode}"
+        )
+        store_key = cc.key(cache_name, sig_str)
+        if disk_ok:
+            # the out-tree sidecar unpickles inside finish= so a damaged
+            # sidecar falls back (counted + warned, no aot-hit recorded)
+            # exactly like a damaged executable
+            got = cc.load_executable_bundle(
+                store_key, name=cache_name, signature=sig_str,
+                finish=lambda exe, meta, blobs: (
+                    exe, pickle.loads(blobs["out_tree"])
+                ),
+            )
+            if got is not None:
+                return got
+        # fresh compile: lowering traces core once (mark_traced fires
+        # under the caller's watch), which also populates
+        # self._out_tree as a trace side effect
+        exe = self._core.lower(
+            param_arrays, buf_arrays, key, in_arrays, in_meta, mode
+        ).compile()
+        out_tree = self._out_tree
+        if disk_ok:
+            cc.store_executable(
+                store_key, exe, name=cache_name, signature=sig_str,
+                extra_blobs={"out_tree": pickle.dumps(out_tree)},
+            )
+        return exe, out_tree
 
     def __call__(self, *args, **kwargs):
         if self._core is not None and (
@@ -351,11 +474,21 @@ class StaticFunction:
                     b._rebind(nb.detach()._data)
             return jax.tree_util.tree_unflatten(self._out_tree, out_flat)
 
-        with _watch:
-            outs, new_buf, _, nflags = self._core(
-                [p._data for p in params], buf_arrays, key, in_arrays,
-                in_meta,
-            )
+        if self._cache_spec is not None and not self._built_nan:
+            # persistent-compile-cache path (eval only: the train path
+            # routes through the tape's vjp machinery, and the nan
+            # debug net needs a live trace to decode its flag indices)
+            with _watch:
+                outs, new_buf, nflags = self._call_aot(
+                    sig, [p._data for p in params], buf_arrays, key,
+                    in_arrays, in_meta,
+                )
+        else:
+            with _watch:
+                outs, new_buf, _, nflags = self._core(
+                    [p._data for p in params], buf_arrays, key,
+                    in_arrays, in_meta,
+                )
         if self._built_nan:
             self._nan_nets[self._cur_nan_key].raise_if(nflags)
         for b, a in zip(self._buffers, new_buf):
@@ -368,7 +501,8 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, check=None, **kwargs):
+              backend=None, full_graph=True, check=None, cache=None,
+              **kwargs):
     """Decorator/wrapper staging a function or Layer (ref: jit/api.py:197).
 
     ``input_spec``/``build_strategy``/``backend`` are accepted for API
@@ -380,17 +514,31 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     (``paddle_tpu.analysis``) over the function on the first call per
     input signature: host syncs, retrace hazards, dtype drift etc.
     surface as structured findings (warned or raised) before staging.
+
+    ``cache=`` (a directory path or ``compilecache.CompileCache``)
+    persists eval-mode compiled executables to disk: a later process
+    staging the same function over the same signature loads the
+    executable with zero tracing and zero compilation
+    (docs/compilecache.md). Training calls and the NaN debug net bypass
+    the cache.
     """
     if check is not None and not full_graph:
         raise ValueError(
             "check= requires full_graph=True (the graph-break fallback "
             "intentionally tolerates host syncs)"
         )
+    if cache is not None and not full_graph:
+        raise ValueError(
+            "cache= requires full_graph=True (graph-break segments "
+            "trace per-branch and are not AOT-serializable as one "
+            "program)"
+        )
 
     def _wrap(obj):
         if isinstance(obj, Layer):
             if full_graph:
-                sf = StaticFunction(obj.forward, layer=obj, check=check)
+                sf = StaticFunction(obj.forward, layer=obj, check=check,
+                                    cache=cache)
             else:
                 from .graph_break import GraphBreakFunction
 
@@ -403,7 +551,7 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             from .graph_break import GraphBreakFunction
 
             return GraphBreakFunction(obj)
-        return StaticFunction(obj, check=check)
+        return StaticFunction(obj, check=check, cache=cache)
 
     if function is not None:
         return _wrap(function)

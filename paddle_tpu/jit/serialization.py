@@ -10,10 +10,25 @@ TensorRT analogue is needed because XLA is the server compiler too.
 Artifact layout at <path>:
     <path>.pdmodel   serialized StableHLO (jax.export blob)
     <path>.pdiparams parameters + buffers (framework save format)
-    <path>.pdmeta    input spec metadata (json)
+    <path>.pdmeta    input spec metadata + export versions (json)
+
+Dynamic dims: XLA programs have static shapes, so a spec dim of
+``None``/``-1`` needs a policy. ``save(..., bucket_sizes={dim: [sizes]})``
+exports ONE PROGRAM PER BUCKET COMBINATION (the ``jit.bucketing``
+policy applied at export time) as ``<path>.b<sizes>.pdmodel`` files;
+``load`` returns a TranslatedLayer that picks the right program by
+shape, pads inputs up to the bucket, and slices padded output dims
+back. Without ``bucket_sizes`` a dynamic dim is exported at size 1
+(call sites must match exactly).
+
+Version safety: the pdmeta records the exporting jax version and
+calling-convention version; ``load`` raises a clear ValueError naming
+both sides when a blob cannot be deserialized under the running jax,
+instead of failing deep inside the deserializer.
 """
 from __future__ import annotations
 
+import itertools
 import json
 
 import numpy as np
@@ -26,8 +41,11 @@ from ..core import autograd
 from ..core.dtype import convert_dtype
 from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
+from .bucketing import next_bucket
 
 __all__ = ["save", "load", "InputSpec", "TranslatedLayer"]
+
+_META_FORMAT = 2
 
 
 class InputSpec:
@@ -38,8 +56,19 @@ class InputSpec:
         self.dtype = convert_dtype(dtype).name
         self.name = name
 
-    def _sds(self):
-        shape = [1 if (d is None or d < 0) else d for d in self.shape]
+    def dynamic_dims(self):
+        return [
+            d for d, v in enumerate(self.shape) if v is None or v < 0
+        ]
+
+    def _sds(self, dim_sizes=None):
+        """Concrete ShapeDtypeStruct; dynamic dims resolve through
+        ``dim_sizes`` ({dim: size}, the bucket combination) or 1."""
+        dim_sizes = dim_sizes or {}
+        shape = [
+            (dim_sizes.get(d, 1) if (v is None or v < 0) else v)
+            for d, v in enumerate(self.shape)
+        ]
         return jax.ShapeDtypeStruct(
             tuple(shape), convert_dtype(self.dtype).jnp_dtype
         )
@@ -52,10 +81,18 @@ class InputSpec:
         return cls(d["shape"], d["dtype"], d.get("name"))
 
 
-def save(layer, path, input_spec=None, **config):
+def _bucket_path(path, combo):
+    return f"{path}.b{'x'.join(str(s) for s in combo)}.pdmodel"
+
+
+def save(layer, path, input_spec=None, bucket_sizes=None, **config):
     """Stage layer.forward on the given specs and export (ref jit/api.py
-    jit.save). Dynamic dims in specs are exported at size 1 (XLA static
-    shapes; re-export per bucket for other sizes)."""
+    jit.save).
+
+    ``bucket_sizes``: {dim_index: [sizes]} covering every dynamic dim
+    in the specs — one program is exported per bucket combination (the
+    ``jit.bucketing`` recompile-avoidance policy, applied ahead of
+    time). Without it, dynamic dims export at size 1."""
     if isinstance(layer, Layer):
         fn = layer.forward
         params = [p for _, p in layer.named_parameters()]
@@ -70,6 +107,27 @@ def save(layer, path, input_spec=None, **config):
         s if isinstance(s, InputSpec) else InputSpec(**s)
         for s in input_spec
     ]
+    dyn_dims = sorted({d for s in specs for d in s.dynamic_dims()})
+    buckets = None
+    if bucket_sizes:
+        buckets = {
+            int(d): sorted(int(v) for v in sizes)
+            for d, sizes in bucket_sizes.items()
+        }
+        missing = [d for d in dyn_dims if d not in buckets]
+        if missing:
+            raise ValueError(
+                f"bucket_sizes covers dims {sorted(buckets)} but the "
+                f"input specs have dynamic dims {dyn_dims} (missing "
+                f"{missing})"
+            )
+        # only dims that are actually dynamic somewhere get programs
+        buckets = {d: buckets[d] for d in dyn_dims}
+        if not buckets:
+            raise ValueError(
+                "bucket_sizes given but no input spec has a dynamic "
+                "dim (use concrete shapes instead)"
+            )
 
     p_arrays = [p._data for p in params]
     b_arrays = [b._data for b in buffers]
@@ -98,20 +156,45 @@ def save(layer, path, input_spec=None, **config):
             is_leaf=lambda o: isinstance(o, Tensor),
         )
 
-    exported = jax_export.export(jax.jit(staged))(
-        [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in p_arrays],
-        [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in b_arrays],
-        *[s._sds() for s in specs],
-    )
-    with open(path + ".pdmodel", "wb") as f:
-        f.write(exported.serialize())
+    def _export(dim_sizes):
+        return jax_export.export(jax.jit(staged))(
+            [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in p_arrays],
+            [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in b_arrays],
+            *[s._sds(dim_sizes) for s in specs],
+        )
+
+    combos = None
+    if buckets:
+        dims = sorted(buckets)
+        combos = [
+            list(c) for c in itertools.product(*[buckets[d] for d in dims])
+        ]
+        exported0 = None
+        for combo in combos:
+            exported = _export(dict(zip(dims, combo)))
+            exported0 = exported0 or exported
+            with open(_bucket_path(path, combo), "wb") as f:
+                f.write(exported.serialize())
+    else:
+        exported0 = _export(None)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exported0.serialize())
     from ..framework.io_api import save as fsave
 
     fsave({"params": state}, path + ".pdiparams")
     with open(path + ".pdmeta", "w") as f:
         json.dump(
             {
+                "format": _META_FORMAT,
+                "jax_version": jax.__version__,
+                "calling_convention_version": getattr(
+                    exported0, "calling_convention_version", None
+                ),
                 "input_spec": [s.to_json() for s in specs],
+                "buckets": (
+                    {"dims": sorted(buckets), "combos": combos}
+                    if buckets else None
+                ),
                 "param_names": [
                     name for name, _ in (
                         layer.named_parameters()
@@ -129,22 +212,173 @@ def save(layer, path, input_spec=None, **config):
         )
 
 
+def _deserialize_program(blob, meta, label):
+    """jax_export.deserialize with a CLEAR failure mode: a version-
+    mismatched or corrupt blob raises a ValueError naming the recorded
+    and running jax versions instead of failing deep in the
+    deserializer."""
+    try:
+        return jax_export.deserialize(blob)
+    except Exception as e:
+        # analysis: allow(broad-except) classify-and-reraise: the
+        # deserializer's failure types are internal and unstable
+        saved = meta.get("jax_version")
+        if saved and saved != jax.__version__:
+            raise ValueError(
+                f"{label}: artifact was exported with jax {saved} "
+                f"(calling convention "
+                f"{meta.get('calling_convention_version')}) but this "
+                f"process runs jax {jax.__version__} and cannot "
+                f"deserialize it — re-export the model under the "
+                f"current jax"
+            ) from e
+        raise ValueError(
+            f"{label}: serialized program is unreadable (corrupt blob "
+            f"or incompatible exporter): {type(e).__name__}: {e}"
+        ) from e
+
+
 class TranslatedLayer:
     """Loaded inference artifact (ref jit/translated_layer.py). Runs the
-    deserialized StableHLO program; parameters are baked as call inputs."""
+    deserialized StableHLO program; parameters are baked as call inputs.
 
-    def __init__(self, exported, param_arrays, buffer_arrays, meta):
-        self._exported = exported
+    Bucketed artifacts hold one program per bucket combination: a call
+    picks the smallest combination covering the actual dynamic-dim
+    sizes, zero-pads the inputs up to it, and slices padded output dims
+    back to the true size. Which output dims to slice is DERIVED, not
+    guessed: an (output, axis) pair tracks a bucket dim iff its exported
+    size varies across that dim's bucket combinations — so a fixed-size
+    output dim that merely coincides with a padded target is left alone.
+    (With a single bucket size per dim there is nothing to compare, and
+    the equal-to-target heuristic is the fallback.)"""
+
+    def __init__(self, exported, param_arrays, buffer_arrays, meta,
+                 programs=None):
+        self._exported = exported          # single-program artifacts
+        self._programs = programs or {}    # {combo: exported}
         self._params = param_arrays
         self._buffers = buffer_arrays
         self._meta = meta
+        buckets = meta.get("buckets") if self._programs else None
+        if buckets:
+            self._bucket_dims = buckets["dims"]
+            self._sizes_per_dim = {
+                d: sorted({c[j] for c in buckets["combos"]})
+                for j, d in enumerate(self._bucket_dims)
+            }
+            self._out_tracking = self._derive_out_tracking()
+
+    def _derive_out_tracking(self):
+        """{bucket dim: {(flat output index, axis)} that track it} —
+        computed once by diffing ``out_avals`` between two programs
+        that differ only in that dim's bucket size. ``None`` per dim
+        when only one size was exported (no pair to compare)."""
+        combos = [tuple(c) for c in self._meta["buckets"]["combos"]]
+        have = set(combos)
+        base = combos[0]
+        tracking = {}
+        for j, d in enumerate(self._bucket_dims):
+            alt = next(
+                (s for s in self._sizes_per_dim[d] if s != base[j]), None
+            )
+            partner = base[:j] + (alt,) + base[j + 1:]
+            avals0 = getattr(self._programs[base], "out_avals", None)
+            if alt is None or partner not in have or avals0 is None:
+                tracking[d] = None  # fall back to the size heuristic
+                continue
+            avals1 = self._programs[partner].out_avals
+            tracking[d] = {
+                (i, k)
+                for i, (a0, a1) in enumerate(zip(avals0, avals1))
+                for k, (s0, s1) in enumerate(zip(a0.shape, a1.shape))
+                if s0 != s1
+            }
+        return tracking
+
+    def _pick_program(self, arrs):
+        """(exported, {dim: (target, required)}) for these inputs."""
+        specs = self._meta["input_spec"]
+        plan = {}
+        for d in self._bucket_dims:
+            required = 0
+            for spec, a in zip(specs, arrs):
+                shape = spec["shape"]
+                if d < len(shape) and (
+                    shape[d] is None or shape[d] < 0
+                ):
+                    required = max(required, a.shape[d])
+            target = next_bucket(required, self._sizes_per_dim[d])
+            plan[d] = (target, required)
+        combo = tuple(plan[d][0] for d in self._bucket_dims)
+        exported = self._programs.get(combo)
+        if exported is None:
+            raise ValueError(
+                f"no exported program for bucket combination {combo} "
+                f"(available: {sorted(self._programs)})"
+            )
+        return exported, plan
+
+    def _pad_inputs(self, arrs, plan):
+        specs = self._meta["input_spec"]
+        out = []
+        for spec, a in zip(specs, arrs):
+            widths = [(0, 0)] * a.ndim
+            padded = False
+            for d, (target, _) in plan.items():
+                shape = spec["shape"]
+                if d < len(shape) and (
+                    shape[d] is None or shape[d] < 0
+                ) and a.shape[d] < target:
+                    widths[d] = (0, target - a.shape[d])
+                    padded = True
+            out.append(jnp.pad(a, widths) if padded else a)
+        return out
+
+    def _slice_outputs(self, out, plan):
+        cuts = {
+            d: (t, r) for d, (t, r) in plan.items() if t != r
+        }
+        if not cuts:
+            return out
+        leaves, treedef = jax.tree_util.tree_flatten(out)
+        new = []
+        for i, y in enumerate(leaves):
+            if not hasattr(y, "ndim"):
+                new.append(y)
+                continue
+            idx = []
+            changed = False
+            for k in range(y.ndim):
+                cut = slice(None)
+                for d, (target, required) in cuts.items():
+                    tracked = self._out_tracking.get(d)
+                    hit = (
+                        (i, k) in tracked if tracked is not None
+                        # single-size bucket: no cross-program diff to
+                        # consult — assume a dim AT the padded target
+                        # tracks it (the pre-derivation heuristic)
+                        else k == d
+                    )
+                    if hit and y.shape[k] == target:
+                        cut = slice(0, required)
+                        changed = True
+                        break
+                idx.append(cut)
+            new.append(y[tuple(idx)] if changed else y)
+        return jax.tree_util.tree_unflatten(treedef, new)
 
     def __call__(self, *inputs):
         arrs = [
             i._data if isinstance(i, Tensor) else jnp.asarray(i)
             for i in inputs
         ]
-        out = self._exported.call(self._params, self._buffers, *arrs)
+        if self._programs:
+            exported, plan = self._pick_program(arrs)
+            arrs = self._pad_inputs(arrs, plan)
+            out = exported.call(self._params, self._buffers, *arrs)
+            out = self._slice_outputs(out, plan)
+        else:
+            out = self._exported.call(self._params, self._buffers, *arrs)
         return jax.tree_util.tree_map(
             lambda a: Tensor(a, stop_gradient=True), out
         )
@@ -163,13 +397,26 @@ class TranslatedLayer:
 
 def load(path, **config):
     """ref jit/api.py paddle.jit.load."""
-    with open(path + ".pdmodel", "rb") as f:
-        exported = jax_export.deserialize(f.read())
+    with open(path + ".pdmeta") as f:
+        meta = json.load(f)
+    buckets = meta.get("buckets")
+    if buckets:
+        programs = {}
+        for combo in buckets["combos"]:
+            with open(_bucket_path(path, combo), "rb") as f:
+                programs[tuple(combo)] = _deserialize_program(
+                    f.read(), meta, _bucket_path(path, combo)
+                )
+        exported = None
+    else:
+        with open(path + ".pdmodel", "rb") as f:
+            exported = _deserialize_program(
+                f.read(), meta, path + ".pdmodel"
+            )
+        programs = None
     from ..framework.io_api import load as fload
 
     blob = fload(path + ".pdiparams")
-    with open(path + ".pdmeta") as f:
-        meta = json.load(f)
     state = blob["params"]
     p_arrays = [
         state[n]._data if isinstance(state[n], Tensor)
@@ -181,4 +428,6 @@ def load(path, **config):
         else jnp.asarray(np.asarray(state[n]))
         for n in meta["buffer_names"]
     ]
-    return TranslatedLayer(exported, p_arrays, b_arrays, meta)
+    return TranslatedLayer(
+        exported, p_arrays, b_arrays, meta, programs=programs
+    )

@@ -19,6 +19,12 @@ traced body calls :func:`mark_traced` at its top. The body of a
 path; the enclosing ``watch`` supplies the event's identity and
 measures elapsed time (trace + compile + first run).
 
+Executables loaded from the persistent compile cache
+(``paddle_tpu.compilecache``) are recorded via :func:`mark_aot_hit`
+under their own ``kind="aot-hit"``: visible in the log and postmortems,
+counted in ``paddle_tpu_jit_aot_hits_total``, but never as a compile or
+a retrace — a warm restart reads as zero compile activity.
+
 ``suppress()`` masks the hooks for trace-only work: ``analysis.check``
 traces programs through the same machinery without ever compiling or
 running them, and must not read as compile activity (the same
@@ -34,8 +40,8 @@ from collections import deque
 from . import metrics as _metrics
 
 __all__ = [
-    "watch", "mark_traced", "suppress", "compile_log",
-    "clear_compile_log", "retraces_after_warmup",
+    "watch", "mark_traced", "mark_aot_hit", "suppress", "compile_log",
+    "clear_compile_log", "retraces_after_warmup", "aot_hits",
 ]
 
 _tls = threading.local()
@@ -52,6 +58,11 @@ _retraces = _metrics.counter(
     "paddle_tpu_jit_retraces_after_warmup_total",
     "traces of a (fn, signature) pair that was already traced once — "
     "a shape/weak-type leak into a warm hot path", ("kind",),
+)
+_aot_hits = _metrics.counter(
+    "paddle_tpu_jit_aot_hits_total",
+    "compiled executables loaded from the persistent compile cache "
+    "instead of traced (compilecache warm restarts)",
 )
 
 
@@ -151,6 +162,33 @@ def mark_traced(name=None, kind=None, signature=None):
         w.events.append(ev)   # elapsed filled at watch exit
     else:
         _emit(ev)
+
+
+def mark_aot_hit(name, signature="", elapsed_s=None):
+    """Record a compiled executable loaded from the persistent compile
+    cache (``paddle_tpu.compilecache``) instead of traced. Logged under
+    its own ``kind="aot-hit"`` so the event is visible next to compiles
+    in postmortems WITHOUT counting as one: it bumps neither
+    ``paddle_tpu_jit_compiles_total`` nor the warm-retrace alarm — a
+    warm restart that replays its manifest must read as zero compile
+    activity."""
+    if _suppressed():
+        return
+    _aot_hits.inc()
+    _emit({
+        "ts": time.time(),
+        "fn": name,
+        "kind": "aot-hit",
+        "signature": str(signature),
+        "trace_no": 0,
+        "retrace": False,
+        "elapsed_s": elapsed_s,
+    })
+
+
+def aot_hits():
+    """Total executables loaded from the persistent compile cache."""
+    return sum(v for _, _, v in _aot_hits.family().samples)
 
 
 def _emit(ev):
