@@ -601,6 +601,84 @@ def bench_resilience(paddle, on_tpu):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_train_resume(paddle, on_tpu):
+    """Preemption-recovery time (train_resume row): run a smoke
+    training job under the elastic TrainLoop, take the emergency
+    checkpoint a SIGTERM would trigger (``train_emergency_ckpt_ms`` —
+    the window a preemption notice must leave open), then measure
+    kill-to-first-resumed-step: a freshly constructed incarnation
+    restoring the full TrainState (model + optimizer + RNG streams +
+    mid-epoch dataloader cursor) and completing its first step
+    (``train_resume_ms``). Process boot + import cost is the
+    [compilecache] warm-restart row's business, not this one's."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.io import (
+        BatchSampler, DataLoader, RandomSampler, TensorDataset,
+    )
+    from paddle_tpu.resilience import TrainLoop, TrainState
+
+    hidden = 512 if on_tpu else 32
+
+    def build():
+        paddle.seed(0)
+        model = paddle.nn.Sequential(
+            paddle.nn.Linear(hidden, hidden), paddle.nn.ReLU(),
+            paddle.nn.Linear(hidden, hidden),
+        )
+        opt = paddle.optimizer.Adam(
+            learning_rate=1e-3, parameters=model.parameters()
+        )
+        data = np.random.RandomState(7).rand(64, hidden).astype(
+            "float32"
+        )
+        ds = TensorDataset([data])
+        loader = DataLoader(ds, batch_sampler=BatchSampler(
+            sampler=RandomSampler(ds, seed=3), batch_size=8,
+        ))
+        state = TrainState(model=model, optimizer=opt,
+                           dataloader=loader)
+
+        def step_fn(batch, st):
+            x = batch[0]
+            loss = ((model(x) - x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        return state, step_fn
+
+    root = tempfile.mkdtemp(prefix="bench_train_resume_")
+    try:
+        state, step_fn = build()
+        TrainLoop(state, step_fn, root).run(6)  # warm, then "preempt"
+        emergency_ms = state.save(root, emergency=True) * 1e3
+        killed_at = state.step
+        state2, step2 = build()
+        t0 = time.perf_counter()
+        TrainLoop(state2, step2, root).run(killed_at + 1)
+        resume_ms = (time.perf_counter() - t0) * 1e3
+        assert state2.step == killed_at + 1
+        log(f"[train_resume] h={hidden} smoke: emergency ckpt "
+            f"{emergency_ms:.0f}ms, kill-to-first-resumed-step "
+            f"{resume_ms:.0f}ms (restore incl. RNG + data cursor)")
+        print(json.dumps({
+            "metric": "train_emergency_ckpt_ms",
+            "value": round(emergency_ms, 1),
+            "unit": "ms",
+        }))
+        print(json.dumps({
+            "metric": "train_resume_ms",
+            "value": round(resume_ms, 1),
+            "unit": "ms",
+        }))
+        return resume_ms
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_analysis(paddle, on_tpu):
     """Static-analyzer overhead (analysis row): wall-time of
     ``analysis.check`` on the serving decode step — the cost of the
@@ -754,6 +832,7 @@ ROWS = {
     "dit": lambda p, tpu, peak: bench_dit(p, tpu),
     "compilecache": lambda p, tpu, peak: bench_compilecache(p, tpu),
     "resilience": lambda p, tpu, peak: bench_resilience(p, tpu),
+    "train_resume": lambda p, tpu, peak: bench_train_resume(p, tpu),
     "analysis": lambda p, tpu, peak: bench_analysis(p, tpu),
     "observability": lambda p, tpu, peak: bench_observability(p, tpu),
 }
@@ -850,8 +929,8 @@ def main():
             return r.returncode
 
         for name in ("decode", "serving", "fleet", "compilecache",
-                     "resilience", "analysis", "observability", "moe",
-                     "resnet", "dit"):
+                     "resilience", "train_resume", "analysis",
+                     "observability", "moe", "resnet", "dit"):
             try:
                 if name == "moe":
                     # shrink ladder: retry in fresh subprocesses until a

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import hashlib
 import io
+import os
 import pickle
 
 import jax
@@ -44,6 +45,21 @@ class AOTUnavailableError(RuntimeError):
     """This jax build cannot serialize compiled executables."""
 
 
+def _xla_flags_digest():
+    """Stable digest of ``XLA_FLAGS``: tokens are whitespace-split and
+    sorted, so reordering the same flags never churns the fingerprint —
+    but ANY flag change (a different optimization level, an added
+    ``--xla_force_host_platform_device_count``) misses the cache
+    cleanly instead of replaying an executable compiled under different
+    compiler behavior."""
+    toks = sorted(
+        t for t in os.environ.get("XLA_FLAGS", "").split() if t
+    )
+    if not toks:
+        return "none"
+    return hashlib.sha256(" ".join(toks).encode()).hexdigest()[:16]
+
+
 def env_fingerprint():
     """The version tuple a serialized executable is only valid under."""
     import platform
@@ -59,6 +75,7 @@ def env_fingerprint():
         "framework": framework_version,
         "python": platform.python_version(),
         "exec_format": EXEC_FORMAT,
+        "xla_flags": _xla_flags_digest(),
     }
 
 

@@ -41,8 +41,8 @@ from .dist_tensor import shard_tensor, to_global_array
 from .placement import Partial, Replicate, Shard
 
 __all__ = [
-    "save_state_dict", "load_state_dict", "wait_async_save",
-    "CheckpointCorruptError",
+    "save_state_dict", "load_state_dict", "load_full",
+    "wait_async_save", "CheckpointCorruptError",
 ]
 
 _META_FILE = "metadata.json"
@@ -463,11 +463,7 @@ def load_state_dict(state_dict, path, process_group=None,
         if info.get("python"):
             state_dict[key] = payload["python_values"].get(key)
             continue
-        arr = data[key]
-        if info.get("dtype") == "bfloat16":
-            import jax.numpy as jnp
-
-            arr = jnp.asarray(arr).astype(jnp.bfloat16)
+        arr = _decode_array(info, data, key)
         if not isinstance(target, Tensor):
             state_dict[key] = Tensor(arr)
             continue
@@ -493,6 +489,38 @@ def load_state_dict(state_dict, path, process_group=None,
         if key not in state_dict:
             unexpected.append(key)
     return missing, unexpected
+
+
+def _decode_array(info, data, key):
+    """One saved array entry -> ndarray (bf16 re-widened) — the single
+    decode point shared by templated and template-free loads, so the
+    on-disk encoding can only ever change in lockstep."""
+    arr = data[key]
+    if info.get("dtype") == "bfloat16":
+        import jax.numpy as jnp
+
+        arr = jnp.asarray(arr).astype(jnp.bfloat16)
+    return arr
+
+
+def load_full(path):
+    """Load EVERY entry of the newest verified checkpoint under
+    ``path`` without a target template — arrays come back as plain
+    Tensors, python values as-is. The training resume path
+    (``resilience.TrainState.load``) needs this: a resuming process
+    cannot know ahead of time which keys (e.g. mid-accumulation
+    ``grad.*`` buffers) the dying incarnation captured. Same fallback
+    semantics as :func:`load_state_dict`."""
+    payload, ckpt_dir = _read_checkpoint(path)
+    data = np.load(os.path.join(ckpt_dir, _DATA_FILE),
+                   allow_pickle=False)
+    sd = {}
+    for key, info in payload["meta"]["tensors"].items():
+        if info.get("python"):
+            sd[key] = payload["python_values"].get(key)
+        else:
+            sd[key] = Tensor(_decode_array(info, data, key))
+    return sd
 
 
 def _read_checkpoint(path):

@@ -25,6 +25,8 @@ import subprocess
 import sys
 import time
 
+from ...resilience.train_state import HANG_EXIT_CODE, PREEMPT_EXIT_CODE
+
 __all__ = ["launch"]
 
 
@@ -51,7 +53,15 @@ def _parse(argv):
                    help="elastic: relaunch the pod up to N times after a "
                         "worker failure (workers resume from their own "
                         "checkpoints; PADDLE_RESTART_COUNT tells them "
-                        "which incarnation they are)")
+                        "which incarnation they are). A pod that exits "
+                        f"{PREEMPT_EXIT_CODE} (preemption after a "
+                        "verified emergency checkpoint) relaunches "
+                        "WITHOUT consuming this budget")
+    p.add_argument("--max_preempt_restarts", type=int, default=100,
+                   help="runaway guard: bound preemption relaunches "
+                        "(which never burn --max_restarts) so a worker "
+                        "stuck in a preempt-exit loop cannot respawn "
+                        "forever")
     p.add_argument("--restart_interval", type=float, default=1.0,
                    help="seconds between elastic relaunches")
     p.add_argument("--elastic", action="store_true",
@@ -111,18 +121,78 @@ def launch(argv=None):
     args = _parse(argv if argv is not None else sys.argv[1:])
     if args.elastic:
         return _elastic_launch(args)
-    restarts = 0
+    restarts = 0    # crash budget consumed (--max_restarts)
+    preempts = 0    # preemption relaunches (budget-free)
+    history = []    # (incarnation, exit code) for the summary
+    reason = None   # restart provenance handed to the NEXT incarnation
     while True:
-        code = _run_pod(args, restarts)
-        if code in (0, 130) or restarts >= args.max_restarts:
+        incarnation = restarts + preempts
+        code = _run_pod(args, incarnation, restart_reason=reason)
+        history.append((incarnation, code))
+        if code in (0, 130):
+            _pod_summary(history)
             return code
-        restarts += 1
+        if code == PREEMPT_EXIT_CODE:
+            # preemption protocol (resilience.train_state): the worker
+            # checkpointed and exited on a preemption notice — relaunch
+            # without burning the crash budget
+            if preempts >= args.max_preempt_restarts:
+                print(
+                    f"elastic: max_preempt_restarts "
+                    f"({args.max_preempt_restarts}) exhausted",
+                    file=sys.stderr,
+                )
+                _pod_summary(history)
+                return code
+            preempts += 1
+            reason = "preempt"
+            print(
+                f"elastic: pod preempted (emergency checkpoint taken); "
+                f"relaunching (preempt {preempts}, crash budget "
+                f"untouched at {restarts}/{args.max_restarts}) in "
+                f"{args.restart_interval}s",
+                file=sys.stderr,
+            )
+        else:
+            if restarts >= args.max_restarts:
+                _pod_summary(history)
+                return code
+            restarts += 1
+            reason = "crash"
+            print(
+                f"elastic: relaunching pod (restart {restarts}/"
+                f"{args.max_restarts}) in {args.restart_interval}s",
+                file=sys.stderr,
+            )
+        time.sleep(args.restart_interval)
+
+
+def _classify_exit(code):
+    if code == 0:
+        return "ok"
+    if code == PREEMPT_EXIT_CODE:
+        return "preempt"
+    if code == HANG_EXIT_CODE:
+        # watchdog-detected stuck step: burns the crash budget like any
+        # failure, but the summary should say what actually happened
+        return "hang"
+    if code == 130:
+        return "interrupt"
+    return "crash"
+
+
+def _pod_summary(history):
+    """Per-incarnation exit codes, printed once at launcher exit so a
+    postmortem reads the whole restart history in one place."""
+    if not history:
+        return
+    print("launch summary:", file=sys.stderr)
+    for incarnation, code in history:
         print(
-            f"elastic: relaunching pod (restart {restarts}/"
-            f"{args.max_restarts}) in {args.restart_interval}s",
+            f"  incarnation {incarnation}: exit={code} "
+            f"({_classify_exit(code)})",
             file=sys.stderr,
         )
-        time.sleep(args.restart_interval)
 
 
 _RESTART_CODE = -999  # internal: pod stopped because the epoch moved on
@@ -148,7 +218,9 @@ def _elastic_launch(args):
     store = TCPStore(
         host, int(port) + 1, is_master=args.rank == 0, timeout=120.0
     )
-    epoch, restarts = 0, 0
+    epoch, restarts, preempts, incarnation = 0, 0, 0, 0
+    reason = None
+    history = []
     while True:
         epoch = max(
             epoch, int(store.get("current_epoch", wait=False) or 0)
@@ -195,25 +267,48 @@ def _elastic_launch(args):
             return int(store.get("current_epoch", wait=False) or 0) > e
 
         code = _run_pod(
-            args, restarts, node_rank=my_rank, nnodes=plan["nnodes"],
+            args, incarnation, node_rank=my_rank, nnodes=plan["nnodes"],
             master=f"{host}:{plan['coord_port']}", stop_check=epoch_moved,
+            restart_reason=reason,
         )
-        if code == 0:
-            return 0
         if code != _RESTART_CODE:
+            history.append((incarnation, code))
+        if code == 0:
+            _pod_summary(history)
+            return 0
+        if code == PREEMPT_EXIT_CODE:
+            # preempted node: checkpointed; rejoin the next epoch
+            # without consuming the crash budget — but under the same
+            # runaway guard as the non-elastic path
+            if preempts >= args.max_preempt_restarts:
+                print(
+                    f"elastic: max_preempt_restarts "
+                    f"({args.max_preempt_restarts}) exhausted",
+                    file=sys.stderr,
+                )
+                _pod_summary(history)
+                return code
+            preempts += 1
+            incarnation += 1
+            reason = "preempt"
+            store.set("current_epoch", str(epoch + 1))
+        elif code != _RESTART_CODE:
             # our pod failed: tell the others and count the restart
             restarts += 1
+            incarnation += 1
+            reason = "crash"
             store.set("current_epoch", str(epoch + 1))
             if restarts > args.max_restarts:
                 print(f"elastic: max_restarts ({args.max_restarts}) "
                       "exhausted", file=sys.stderr)
+                _pod_summary(history)
                 return code
         epoch += 1
         time.sleep(args.restart_interval)
 
 
 def _run_pod(args, restart_count=0, node_rank=None, nnodes=None,
-             master=None, stop_check=None):
+             master=None, stop_check=None, restart_reason=None):
     os.makedirs(args.log_dir, exist_ok=True)
 
     procs = []
@@ -228,6 +323,12 @@ def _run_pod(args, restart_count=0, node_rank=None, nnodes=None,
         env = _worker_env(args, local_rank, node_rank=node_rank,
                           nnodes=nnodes, master=master)
         env["PADDLE_RESTART_COUNT"] = str(restart_count)
+        # restart provenance: preempt|crash next to the incarnation
+        # count, so a resuming worker can tell a budget-free preemption
+        # relaunch from a crash recovery (first incarnations get none)
+        env.pop("PADDLE_RESTART_REASON", None)
+        if restart_reason is not None:
+            env["PADDLE_RESTART_REASON"] = restart_reason
         proc = subprocess.Popen(
             cmd, env=env,
             stdout=log_f, stderr=subprocess.STDOUT,

@@ -275,7 +275,7 @@ class _MPLoaderIter:
         self._grace = float(getattr(loader, "timeout", 0) or 5.0)
         self._index_q = ctx.Queue()
         self._result_q = ctx.Queue()
-        self._batches = list(enumerate(loader.batch_sampler))
+        self._batches = list(enumerate(loader._index_batches()))
         self._total = len(self._batches)
         # bounded prefetch (the reference's outstanding-batch window,
         # dataloader_iter.py _outstanding_capacity): only this many index
@@ -440,6 +440,47 @@ class DataLoader:
                 dataset, shuffle=shuffle, batch_size=batch_size,
                 drop_last=drop_last,
             )
+        # mid-epoch resume cursor (training resume contract,
+        # docs/resilience.md): batches DELIVERED to the consumer this
+        # epoch — tracked at yield time, so prefetch depth never leaks
+        # into the cursor
+        self._served_in_epoch = 0
+        self._resume_skip = 0
+
+    # -- training resume contract ------------------------------------------
+    def state_dict(self):
+        """Mid-epoch cursor: batches delivered this epoch plus the
+        sampler's shuffle state (epoch-start RNG / epoch number), enough
+        to regenerate the same index stream and skip forward. Assumes a
+        single active iterator (the training loop's)."""
+        sd = {"batches_served": self._served_in_epoch}
+        if self.batch_sampler is not None and hasattr(
+            self.batch_sampler, "state_dict"
+        ):
+            sd["sampler"] = self.batch_sampler.state_dict()
+        return sd
+
+    def load_state_dict(self, state):
+        """Arm the next ``__iter__`` to skip the already-consumed
+        batches. Map-style datasets skip at the INDEX level (no sample
+        is loaded); iterable datasets must consume-and-drop, since the
+        stream has no random access."""
+        self._resume_skip = int(state.get("batches_served", 0))
+        self._served_in_epoch = self._resume_skip
+        if state.get("sampler") is not None and hasattr(
+            self.batch_sampler, "load_state_dict"
+        ):
+            self.batch_sampler.load_state_dict(state["sampler"])
+
+    def _index_batches(self):
+        """Index-batch stream with the resume skip applied (consumed
+        once; later epochs start at batch 0)."""
+        skip, self._resume_skip = self._resume_skip, 0
+        it = iter(self.batch_sampler)
+        for _ in range(skip):
+            if next(it, None) is None:
+                break
+        yield from it
 
     def __len__(self):
         if self._iterable_mode:
@@ -447,18 +488,23 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _batches_map(self):
-        for indices in self.batch_sampler:
+        for indices in self._index_batches():
             yield [self.dataset[i] for i in indices]
 
     def _batches_iterable(self):
+        skip, self._resume_skip = self._resume_skip, 0
         batch = []
         for sample in self.dataset:
             batch.append(sample)
             if len(batch) == self.batch_size:
-                yield batch
+                if skip > 0:
+                    skip -= 1  # consume-and-drop: streams can't seek
+                else:
+                    yield batch
                 batch = []
         if batch and not getattr(self, "drop_last", False):
-            yield batch
+            if skip <= 0:
+                yield batch
 
     def _produce(self):
         gen = (
@@ -484,9 +530,26 @@ class DataLoader:
                               and not self._iterable_mode)
             else "thread"
         )
+        # the armed skip (if any) counts as already-served; delivered
+        # batches advance the cursor from there
+        self._served_in_epoch = self._resume_skip
         for batch in self._iter_impl():
             batches.inc(transport=transport)
+            self._served_in_epoch += 1
             yield batch
+        # the epoch COMPLETED (we reached exhaustion, not an abandoned
+        # iterator): the cursor now refers to the next epoch. Without
+        # this, a checkpoint taken in the rollover window — after the
+        # consumer saw StopIteration, before the next epoch's first
+        # batch — records the old epoch's full count against the new
+        # epoch and a resume would skip that epoch entirely. The
+        # sampler's epoch-start RNG snapshot is stale in the same
+        # window — roll it forward too, or the resume replays the
+        # finished epoch's permutation as the next epoch's.
+        self._served_in_epoch = 0
+        roll = getattr(self.batch_sampler, "_roll_epoch", None)
+        if roll is not None:
+            roll()
 
     def _iter_impl(self):
         if self.num_workers == 0:
@@ -508,7 +571,7 @@ class DataLoader:
                 # map-style: item loading happens INSIDE the job so worker
                 # threads overlap dataset reads (the reference's
                 # multiprocess worker loop, worker.py:293)
-                for indices in self.batch_sampler:
+                for indices in self._index_batches():
                     yield (
                         lambda idx=indices: _to_device(
                             self.collate_fn(

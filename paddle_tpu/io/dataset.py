@@ -139,9 +139,12 @@ def random_split(dataset, lengths, generator=None):
         raise ValueError(
             "sum of input lengths does not equal the dataset length"
         )
-    rng = np.random.RandomState(
-        generator if isinstance(generator, int) else None
-    )
+    # per-instance RNG via the sampler helper: an int seed or an np
+    # RandomState/Generator is honored (a non-int generator was silently
+    # ignored before), and the global np.random stream is never touched
+    from .sampler import _new_rng
+
+    rng = _new_rng(None, generator)
     perm = rng.permutation(sum(lengths)).tolist()
     out, off = [], 0
     for n in lengths:

@@ -8,14 +8,31 @@ Three pieces shared by every subsystem (see docs/resilience.md):
     the recovery path actually runs.
   * ``RetryPolicy`` — the unified exponential-backoff/jitter/deadline
     retry loop used by TCPStore, distributed.rpc, and shard_loader.
+  * ``train_state`` — the bit-exact training resume contract:
+    ``TrainState`` capture/restore (model + optimizer + LR + AMP +
+    grad-accum phase + all RNG streams + dataloader cursor), the
+    preemption exit-code protocol with the elastic launcher, and the
+    hang-safe ``TrainLoop``.
   * checkpoint hardening, serving degradation, and dataloader shutdown
-    escalation live in their own subsystems but are built on the two
+    escalation live in their own subsystems but are built on the
     primitives above.
 """
-from . import faults
+from . import faults, train_state
 from .faults import FaultInjector, FaultSpec
 from .retry import RetryPolicy, retry_call
+from .train_state import (
+    HANG_EXIT_CODE,
+    PREEMPT_EXIT_CODE,
+    PreemptionHandler,
+    TrainLoop,
+    TrainState,
+    preemption_requested,
+    request_preemption,
+)
 
 __all__ = [
     "faults", "FaultSpec", "FaultInjector", "RetryPolicy", "retry_call",
+    "train_state", "TrainState", "TrainLoop", "PreemptionHandler",
+    "request_preemption", "preemption_requested", "PREEMPT_EXIT_CODE",
+    "HANG_EXIT_CODE",
 ]

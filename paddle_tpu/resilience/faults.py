@@ -37,6 +37,11 @@ docs/resilience.md):
                        degrade to a retry on the next fleet step, never
                        to a dropped request
     dataloader.worker  one process-worker job (context: worker_id=)
+    train.step         one elastic-train-loop step, fired BEFORE the
+                       step body (context: step=) — the chaos hook the
+                       bit-exact resume contract is verified through:
+                       an injected death lands on a step boundary,
+                       where TrainState capture/restore is exact
     collective         one watched eager collective (context: op=)
     analysis.pass      one static-analyzer pass invocation (context:
                        rule=) — lets tests assert a crashing analyzer

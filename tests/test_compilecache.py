@@ -305,6 +305,49 @@ class TestKeysAndManifest:
         assert m.load() == []
 
 
+class TestXlaFlagsFingerprint:
+    def test_flag_flip_misses_cleanly(self, tmp_path, monkeypatch,
+                                      capsys):
+        """XLA flags change compiler behavior without touching any
+        version number — they must fold into the environment
+        fingerprint. Flipping ``XLA_FLAGS`` re-keys the same (fn,
+        signature) (clean miss); reordering the SAME flags does not
+        churn the digest; and a force-fetch of an artifact recorded
+        under the old flags is a counted fallback, never a hit."""
+        monkeypatch.delenv("XLA_FLAGS", raising=False)
+        base = compilecache.env_fingerprint()
+        assert base["xla_flags"] == "none"
+        cc = CompileCache(str(tmp_path))
+        key = cc.key("f", "sig")
+        cc.store.put(
+            key, {"exec": b"payload"}, {"name": "f", "env": cc.env}
+        )
+
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_cpu_multi_thread_eigen=false --xla_foo_bar=3",
+        )
+        flipped = compilecache.env_fingerprint()
+        assert flipped["xla_flags"] not in ("none", base["xla_flags"])
+        cc2 = CompileCache(str(tmp_path))  # re-reads the environment
+        assert cc2.key("f", "sig") != key  # clean miss by key
+
+        # same flags, different token order: identical fingerprint
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_foo_bar=3   --xla_cpu_multi_thread_eigen=false",
+        )
+        assert compilecache.env_fingerprint() == flipped
+
+        # even fetching the OLD key directly degrades: the recorded env
+        # disagrees with the running one -> fallback, never a hit
+        cc3 = CompileCache(str(tmp_path))
+        assert cc3.fetch(key, name="f") is None
+        snap = cc3.metrics.snapshot()
+        assert snap["fallbacks"] == 1 and snap["hits"] == 0
+        assert "environment mismatch" in capsys.readouterr().err
+
+
 class TestCacheAccounting:
     """Hit accounting is deferred until the WHOLE bundle validates: a
     fetched-but-unusable artifact is one fallback, never a hit — so

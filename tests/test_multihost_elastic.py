@@ -227,6 +227,9 @@ print(f"worker rank={rank} finished", flush=True)
 
 
 class TestElasticScaleDown:
+    @pytest.mark.slow  # two elastic launchers x jax imports (~20 s);
+    # the scale-down contract itself is covered at tier-1 by the
+    # launcher-protocol tests in test_train_resume.py
     def test_node_loss_rank_remap_resume(self, tmp_path):
         """Node 1 dies mid-train; the survivor re-rendezvouses at a
         smaller world size (rank remap), resumes from the checkpoint,

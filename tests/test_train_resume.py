@@ -1,0 +1,941 @@
+"""Preemption-safe training: the bit-exact resume contract
+(paddle_tpu/resilience/train_state.py; docs/resilience.md).
+
+Three layers of proof, cheapest first:
+
+* in-process: TrainState capture/restore round-trips every stream
+  (model/opt/LR/AMP/grad-accum/RNG/dataloader cursor) bit-exactly;
+* launcher protocol: PADDLE_RESTART_REASON provenance and the
+  budget-free preemption relaunch, with jax-free worker stubs;
+* chaos harness: a worker killed at a seeded ``train.step`` fault (or
+  SIGTERM-preempted) and resumed through the elastic launcher produces
+  final weights BIT-IDENTICAL to the uninterrupted run. Compile-lean:
+  a 4-unit MLP on CPU, one jax import per incarnation; the
+  multi-process pod variant is marked ``slow``.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (
+    BatchSampler, DataLoader, DistributedBatchSampler, RandomSampler,
+    TensorDataset,
+)
+from paddle_tpu.resilience import (
+    HANG_EXIT_CODE, PREEMPT_EXIT_CODE, FaultSpec, TrainLoop, TrainState,
+    faults, request_preemption,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- shared tiny-training fixture pieces ------------------------------------
+
+
+def _build(accum_steps=1, with_scaler=False):
+    """Deterministically-constructed tiny training job: dropout (jax
+    key), shuffled sampler (instance RNG), LR schedule, Adam state."""
+    paddle.seed(0)
+    np.random.seed(123)
+    import random as pyrandom
+
+    pyrandom.seed(321)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(4, 8), paddle.nn.Dropout(0.5),
+        paddle.nn.Linear(8, 4),
+    )
+    opt = paddle.optimizer.Adam(
+        learning_rate=paddle.optimizer.lr.StepDecay(0.05, step_size=3),
+        parameters=model.parameters(),
+    )
+    scaler = (
+        paddle.amp.GradScaler(init_loss_scaling=2.0**10)
+        if with_scaler else None
+    )
+    data = np.arange(64, dtype=np.float32).reshape(16, 4) / 64.0
+    ds = TensorDataset([data])
+    loader = DataLoader(
+        ds,
+        batch_sampler=BatchSampler(
+            sampler=RandomSampler(ds, seed=7), batch_size=4
+        ),
+    )
+    state = TrainState(
+        model=model, optimizer=opt, scaler=scaler, dataloader=loader,
+        accum_steps=accum_steps,
+    )
+
+    def step_fn(batch, st):
+        x = batch[0]
+        loss = ((model(x) - x) ** 2).mean()
+        if scaler is not None:
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+            return loss
+        loss.backward()
+        if st.accum_steps > 1:
+            st.accum_phase += 1
+            if st.accum_phase >= st.accum_steps:
+                opt.step()
+                opt.clear_grad()
+                st.accum_phase = 0
+        else:
+            opt.step()
+            opt.clear_grad()
+        return loss
+
+    return state, step_fn
+
+
+def _weights(state):
+    return {
+        k: np.asarray(v.numpy())
+        for k, v in state.model.state_dict().items()
+    }
+
+
+def _assert_bit_identical(wa, wb):
+    assert set(wa) == set(wb)
+    for k in wa:
+        assert wa[k].tobytes() == wb[k].tobytes(), (
+            f"{k}: max abs diff {np.abs(wa[k] - wb[k]).max()}"
+        )
+
+
+# -- in-process bit-exactness ----------------------------------------------
+
+
+class TestTrainStateBitExact:
+    def test_mid_epoch_capture_restore(self, tmp_path):
+        """Kill-free statement of the contract: save at a step
+        boundary, rebuild EVERYTHING from scratch, restore, continue —
+        final weights bit-identical to never having stopped. Step 6 of
+        10 is mid-epoch (4 batches/epoch), so the dataloader cursor,
+        sampler RNG, dropout key, LR schedule, and Adam moments are all
+        live state at the capture point."""
+        st, fn = _build()
+        TrainLoop(st, fn, str(tmp_path / "a")).run(10)
+        want = _weights(st)
+
+        st, fn = _build()
+        TrainLoop(st, fn, str(tmp_path / "b")).run(6)
+        st.save(str(tmp_path / "b"))
+        st2, fn2 = _build()
+        TrainLoop(st2, fn2, str(tmp_path / "b")).run(10)
+        assert st2.step == 10
+        _assert_bit_identical(want, _weights(st2))
+
+    def test_mid_accum_window_capture(self, tmp_path):
+        """A checkpoint taken mid-gradient-accumulation-window captures
+        the phase AND the half-summed grad buffers; the resumed run
+        finishes the window bit-exactly."""
+        st, fn = _build(accum_steps=2)
+        TrainLoop(st, fn, str(tmp_path / "a")).run(9)
+        want = _weights(st)
+
+        st, fn = _build(accum_steps=2)
+        TrainLoop(st, fn, str(tmp_path / "b")).run(5)
+        assert st.accum_phase == 1  # mid-window by construction
+        st.save(str(tmp_path / "b"))
+        st2, fn2 = _build(accum_steps=2)
+        st2.load(str(tmp_path / "b"))
+        assert st2.accum_phase == 1
+        assert all(
+            p.grad is not None for p in st2.optimizer._parameter_list
+        )
+        TrainLoop(st2, fn2, str(tmp_path / "b")).run(9)
+        _assert_bit_identical(want, _weights(st2))
+
+    def test_scaler_state_roundtrip(self, tmp_path):
+        st, fn = _build(with_scaler=True)
+        TrainLoop(st, fn, str(tmp_path / "c")).run(4)
+        st.scaler._scale = 1234.5
+        st.save(str(tmp_path / "c"))
+        st2, _ = _build(with_scaler=True)
+        st2.load(str(tmp_path / "c"))
+        assert st2.scaler.get_scale_ratio() == 1234.5
+
+    def test_emergency_checkpoint_on_preemption_notice(self, tmp_path):
+        """request_preemption() (the programmatic SIGTERM) checkpoints
+        at the next step boundary, exits PREEMPT_EXIT_CODE, and the
+        checkpoint resumes bit-exactly."""
+        st, fn = _build()
+        TrainLoop(st, fn, str(tmp_path / "a")).run(10)
+        want = _weights(st)
+
+        st, fn = _build()
+        fired = []
+
+        def preempting_fn(batch, s):
+            out = fn(batch, s)
+            if s.step == 4 and not fired:
+                fired.append(True)
+                request_preemption()
+            return out
+
+        with pytest.raises(SystemExit) as e:
+            TrainLoop(st, preempting_fn, str(tmp_path / "b")).run(10)
+        assert e.value.code == PREEMPT_EXIT_CODE
+        # the emergency checkpoint is verified v2 and resumes exactly
+        st2, fn2 = _build()
+        assert st2.try_load(str(tmp_path / "b"))
+        assert st2.step == 5
+        TrainLoop(st2, fn2, str(tmp_path / "b")).run(10)
+        _assert_bit_identical(want, _weights(st2))
+
+    def test_real_sigterm_emergency_ckpt(self, tmp_path):
+        """An actual SIGTERM (not the programmatic notice) lands in the
+        installed handler mid-step; the next step boundary takes a
+        verified emergency checkpoint and exits PREEMPT_EXIT_CODE —
+        the crash-restart budget is a launcher concept and 76 is
+        exactly the code it relaunches budget-free (pinned by
+        TestLauncherPreemptProtocol)."""
+        st, fn = _build()
+
+        def sigterm_fn(batch, s):
+            out = fn(batch, s)
+            if s.step == 3:
+                os.kill(os.getpid(), signal.SIGTERM)
+            return out
+
+        with pytest.raises(SystemExit) as e:
+            TrainLoop(st, sigterm_fn, str(tmp_path / "s")).run(10)
+        assert e.value.code == PREEMPT_EXIT_CODE
+        st2, _ = _build()
+        assert st2.try_load(str(tmp_path / "s"))
+        assert st2.step == 4  # checkpointed the completed step
+
+    def test_hang_exits_for_elastic_relaunch(self, tmp_path):
+        """A stuck-but-unwinding step under a CommWatchdog deadline
+        converts the trip to SystemExit(HANG_EXIT_CODE) — the
+        cooperative hang path. (The hard path — a step that never
+        returns gets os._exit'd from the watchdog thread — is pinned
+        end-to-end by the chaos harness 'hang' variant.)"""
+        from paddle_tpu.distributed.watchdog import CommWatchdog
+
+        st, fn = _build()
+        wd = CommWatchdog(timeout=0.4, poll_interval=0.05)
+        try:
+            def stuck_fn(batch, s):
+                if s.step == 2:
+                    time.sleep(1.0)  # > deadline, then unwinds
+                return fn(batch, s)
+
+            loop = TrainLoop(
+                st, stuck_fn, str(tmp_path / "h"), watchdog=wd,
+                hang_grace=30.0,  # cooperative unwind must win here
+            )
+            with pytest.raises(SystemExit) as e:
+                loop.run(10)
+            assert e.value.code == HANG_EXIT_CODE
+            assert wd.fired is not None
+            assert loop._hang_unwound.is_set()
+        finally:
+            wd.shutdown()
+
+    def test_notice_before_run_is_honored(self, tmp_path):
+        """A notice that arrives BEFORE run() (a bootstrap cloud-notice
+        poller) is honored at the first step boundary — and consumed
+        there, so the relaunched loop trains normally and stays
+        bit-exact."""
+        st, fn = _build()
+        TrainLoop(st, fn, str(tmp_path / "a")).run(10)
+        want = _weights(st)
+
+        st, fn = _build()
+        request_preemption()
+        with pytest.raises(SystemExit) as e:
+            TrainLoop(st, fn, str(tmp_path / "n")).run(10)
+        assert e.value.code == PREEMPT_EXIT_CODE
+        assert st.step == 0  # checkpointed before any step
+        st2, fn2 = _build()
+        TrainLoop(st2, fn2, str(tmp_path / "n")).run(10)
+        _assert_bit_identical(want, _weights(st2))
+
+    def test_train_step_fault_site(self, tmp_path):
+        st, fn = _build()
+        with faults.inject(
+            {"train.step": FaultSpec(RuntimeError("chaos"), at=3)}
+        ) as inj:
+            with pytest.raises(RuntimeError, match="chaos"):
+                TrainLoop(st, fn, str(tmp_path / "f")).run(10)
+        assert inj.fired["train.step"] == 1
+        assert st.step == 2  # fired before the 3rd step body
+
+
+class TestEpochBoundaryPreempt:
+    def _build_epoch_keyed(self):
+        """Tiny RNG-free job over the epoch-keyed
+        DistributedBatchSampler — the sampler whose shuffle is a pure
+        function of the epoch number, so a stale dataloader cursor is
+        NOT cancelled by captured RNG state."""
+        paddle.seed(0)
+        model = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.05, parameters=model.parameters()
+        )
+        data = np.arange(64, dtype=np.float32).reshape(16, 4) / 64.0
+        ds = TensorDataset([data])
+        loader = DataLoader(ds, batch_sampler=DistributedBatchSampler(
+            ds, batch_size=4, num_replicas=1, rank=0, shuffle=True,
+        ))
+        st = TrainState(model=model, optimizer=opt, dataloader=loader)
+
+        def fn(batch, s):
+            x = batch[0]
+            loss = ((model(x) - x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        return st, fn
+
+    def test_rollover_window_preempt_bit_identical(self, tmp_path):
+        """A preemption notice landing in the rollover window — after
+        an epoch's iterator exhausted, before the next epoch's first
+        batch — must checkpoint a cursor for the NEW epoch (0 served),
+        not the old epoch's full count; otherwise the resume silently
+        skips an entire epoch of data."""
+        st, fn = self._build_epoch_keyed()
+        TrainLoop(st, fn, str(tmp_path / "a")).run(12)
+        want = _weights(st)
+
+        fired = []
+
+        class WindowLoop(TrainLoop):
+            def _sync_epoch(self):
+                super()._sync_epoch()
+                # fire exactly in the rollover window to epoch 1
+                if self.state.epoch == 1 and not fired:
+                    fired.append(True)
+                    request_preemption()
+
+        st, fn = self._build_epoch_keyed()
+        with pytest.raises(SystemExit) as e:
+            WindowLoop(st, fn, str(tmp_path / "b")).run(12)
+        assert e.value.code == PREEMPT_EXIT_CODE
+        assert st.step == 4 and st.epoch == 1
+
+        st2, fn2 = self._build_epoch_keyed()
+        st2.load(str(tmp_path / "b"))
+        assert st2.dataloader.state_dict()["batches_served"] == 0
+        TrainLoop(st2, fn2, str(tmp_path / "b")).run(12)
+        _assert_bit_identical(want, _weights(st2))
+
+    def test_rollover_window_preempt_random_sampler(self, tmp_path):
+        """Same window, RandomState-backed sampler: the sampler's
+        epoch-start RNG snapshot must roll forward at exhaustion, or
+        the resume replays the finished epoch's permutation as the
+        next epoch's (training the same order twice)."""
+        st, fn = _build()
+        TrainLoop(st, fn, str(tmp_path / "a")).run(10)
+        want = _weights(st)
+
+        fired = []
+
+        class WindowLoop(TrainLoop):
+            def _sync_epoch(self):
+                super()._sync_epoch()
+                if self.state.epoch == 1 and not fired:
+                    fired.append(True)
+                    request_preemption()
+
+        st, fn = _build()
+        with pytest.raises(SystemExit) as e:
+            WindowLoop(st, fn, str(tmp_path / "b")).run(10)
+        assert e.value.code == PREEMPT_EXIT_CODE
+        assert st.step == 4 and st.epoch == 1
+
+        st2, fn2 = _build()
+        TrainLoop(st2, fn2, str(tmp_path / "b")).run(10)
+        _assert_bit_identical(want, _weights(st2))
+
+
+class TestPreemptBarrier:
+    def test_two_ranks_coordinate_emergency_ckpt(self, tmp_path):
+        """Multi-rank preemption: the notice propagates through the
+        TCPStore, both ranks meet the checkpoint barriers, the
+        coordinator saves, and both exit PREEMPT_EXIT_CODE."""
+        from paddle_tpu.distributed import TCPStore
+
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        master = TCPStore("127.0.0.1", port, is_master=True, timeout=10)
+        codes = {}
+
+        def rank_body(rank):
+            store = TCPStore("127.0.0.1", port, timeout=10)
+            st, fn = _build()
+
+            def slow_fn(batch, s_):
+                time.sleep(0.05)
+                return fn(batch, s_)
+
+            loop = TrainLoop(
+                st, slow_fn, str(tmp_path / "c"), store=store,
+                world=2, rank=rank, barrier_timeout=10.0,
+            )
+            try:
+                loop.run(200)
+            except SystemExit as e:
+                codes[rank] = e.code
+            finally:
+                store.close()
+
+        ts = [
+            threading.Thread(target=rank_body, args=(r,))
+            for r in (0, 1)
+        ]
+        for t in ts:
+            t.start()
+        time.sleep(0.5)  # both loops installed + stepping
+        request_preemption()
+        for t in ts:
+            t.join(timeout=30)
+        master.close()
+        assert codes == {0: PREEMPT_EXIT_CODE, 1: PREEMPT_EXIT_CODE}
+        # the coordinator's emergency checkpoint is loadable
+        st2, _ = _build()
+        assert st2.try_load(str(tmp_path / "c"))
+
+
+# -- resumable sampler / dataloader cursor ----------------------------------
+
+
+class TestResumableData:
+    def test_random_sampler_leaves_global_stream_alone(self):
+        ds = list(range(32))
+        np.random.seed(0)
+        want = np.random.rand()
+        np.random.seed(0)
+        s = RandomSampler(ds, seed=11)
+        list(iter(s))
+        assert np.random.rand() == want  # global stream untouched
+        # seeded instances are reproducible
+        a = list(iter(RandomSampler(ds, seed=5)))
+        b = list(iter(RandomSampler(ds, seed=5)))
+        assert a == b and a != list(range(32))
+
+    def test_random_sampler_state_roundtrip(self):
+        ds = list(range(32))
+        s = RandomSampler(ds, seed=3)
+        epochs = [list(iter(s)) for _ in range(3)]
+        s2 = RandomSampler(ds, seed=99)
+        s2.load_state_dict(s.state_dict())
+        # state was snapshotted at the START of s's last epoch
+        assert list(iter(s2)) == epochs[-1]
+
+    def test_dataloader_mid_epoch_cursor(self):
+        data = np.arange(64, dtype=np.float32).reshape(16, 4)
+        ds = TensorDataset([data])
+
+        def make():
+            return DataLoader(
+                ds,
+                batch_sampler=BatchSampler(
+                    sampler=RandomSampler(ds, seed=13), batch_size=4
+                ),
+            )
+
+        ref = make()
+        it = iter(ref)
+        consumed = [np.asarray(next(it)[0].numpy()) for _ in range(2)]
+        sd = ref.state_dict()
+        assert sd["batches_served"] == 2
+        rest = [np.asarray(b[0].numpy()) for b in it]
+
+        fresh = make()
+        fresh.load_state_dict(sd)
+        resumed = [np.asarray(b[0].numpy()) for b in fresh]
+        assert len(resumed) == len(rest) == 2
+        for a, b in zip(rest, resumed):
+            assert a.tobytes() == b.tobytes()
+        # the NEXT epoch starts at batch 0 again, same shuffle stream
+        nxt_ref = [np.asarray(b[0].numpy()) for b in ref]
+        nxt_res = [np.asarray(b[0].numpy()) for b in fresh]
+        assert len(nxt_ref) == 4
+        for a, b in zip(nxt_ref, nxt_res):
+            assert a.tobytes() == b.tobytes()
+        assert consumed  # silence unused warning
+
+    def test_generator_replacement_draws(self):
+        """np.random.Generator has .integers, not .randint — the
+        with-replacement path must use the right one."""
+        ds = list(range(16))
+        s = RandomSampler(ds, replacement=True, num_samples=8,
+                          generator=np.random.default_rng(2))
+        out = list(iter(s))
+        assert len(out) == 8 and all(0 <= i < 16 for i in out)
+
+    def test_framework_generator_adapted(self):
+        """The framework's core.random.Generator (the natural paddle
+        value to pass) is adapted via initial_seed(), reproducibly."""
+        from paddle_tpu.core.random import Generator as FwGen
+
+        ds = list(range(16))
+        a = list(iter(RandomSampler(ds, generator=FwGen(5))))
+        b = list(iter(RandomSampler(ds, generator=FwGen(5))))
+        assert a == b and a != sorted(a)
+
+    def test_unknown_generator_warns_not_raises(self):
+        """Pre-contract code passed arbitrary objects as generator=
+        (they were silently ignored); that must degrade to a warning,
+        not a constructor TypeError."""
+        ds = list(range(8))
+        with pytest.warns(RuntimeWarning):
+            s = RandomSampler(ds, generator=object())
+        assert sorted(iter(s)) == list(range(8))
+
+    def test_user_generator_sampler_checkpoints(self, tmp_path):
+        """A user-supplied np.random.Generator sampler is capturable
+        too: the emergency-checkpoint path must never crash on a
+        sampler, the state round-trips through checkpoint v2's json
+        python values, and the resumed run stays bit-exact."""
+        def build():
+            paddle.seed(0)
+            model = paddle.nn.Linear(4, 4)
+            opt = paddle.optimizer.Adam(
+                learning_rate=0.01, parameters=model.parameters()
+            )
+            data = np.arange(32, dtype=np.float32).reshape(8, 4) / 32.0
+            ds = TensorDataset([data])
+            loader = DataLoader(ds, batch_sampler=BatchSampler(
+                sampler=RandomSampler(
+                    ds, generator=np.random.default_rng(9)
+                ),
+                batch_size=4,
+            ))
+            st = TrainState(model=model, optimizer=opt,
+                            dataloader=loader)
+
+            def fn(batch, s):
+                x = batch[0]
+                loss = ((model(x) - x) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss
+
+            return st, fn
+
+        st, fn = build()
+        TrainLoop(st, fn, str(tmp_path / "a")).run(6)
+        want = _weights(st)
+
+        st, fn = build()
+        TrainLoop(st, fn, str(tmp_path / "b")).run(3)
+        st.save(str(tmp_path / "b"), emergency=True)  # must not raise
+        st2, fn2 = build()
+        st2.load(str(tmp_path / "b"))
+        assert st2.step == 3
+        TrainLoop(st2, fn2, str(tmp_path / "b")).run(6)
+        _assert_bit_identical(want, _weights(st2))
+
+    def test_epoch_exhaustion_resets_cursor(self):
+        """Consuming an epoch through StopIteration moves the cursor to
+        the NEXT epoch (0 served): a checkpoint taken in the rollover
+        window must not record the old epoch's full count against the
+        new epoch (a resume would skip that epoch entirely)."""
+        data = np.arange(64, dtype=np.float32).reshape(16, 4)
+        ds = TensorDataset([data])
+        loader = DataLoader(ds, batch_sampler=BatchSampler(
+            sampler=RandomSampler(ds, seed=13), batch_size=4,
+        ))
+        assert len(list(iter(loader))) == 4  # exhausted, not abandoned
+        assert loader.state_dict()["batches_served"] == 0
+
+    def test_distributed_batch_sampler_state(self):
+        ds = list(range(20))
+        s = DistributedBatchSampler(
+            ds, batch_size=2, num_replicas=2, rank=0, shuffle=True
+        )
+        s.set_epoch(5)
+        order5 = [list(b) for b in s]
+        sd = s.state_dict()
+        assert sd["epoch"] == 5
+        s2 = DistributedBatchSampler(
+            ds, batch_size=2, num_replicas=2, rank=0, shuffle=True
+        )
+        s2.load_state_dict(sd)
+        assert [list(b) for b in s2] == order5
+
+
+# -- launcher protocol (jax-free stubs: fast) -------------------------------
+
+
+class TestLauncherPreemptProtocol:
+    def test_preempt_exit_does_not_burn_budget(self, tmp_path, capsys):
+        """max_restarts=0, yet a PREEMPT_EXIT_CODE exit relaunches —
+        and the second incarnation sees PADDLE_RESTART_REASON=preempt."""
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys\n"
+            f"out = {str(tmp_path / 'env.jsonl')!r}\n"
+            "import json\n"
+            "with open(out, 'a') as f:\n"
+            "    f.write(json.dumps({\n"
+            "        'count': os.environ['PADDLE_RESTART_COUNT'],\n"
+            "        'reason': os.environ.get('PADDLE_RESTART_REASON'),\n"
+            "    }) + '\\n')\n"
+            "if os.environ['PADDLE_RESTART_COUNT'] == '0':\n"
+            f"    sys.exit({PREEMPT_EXIT_CODE})\n"
+        )
+        from paddle_tpu.distributed.launch.main import launch
+
+        code = launch([
+            "--log_dir", str(tmp_path / "logs"), "--max_restarts", "0",
+            "--restart_interval", "0.05", str(script),
+        ])
+        assert code == 0
+        rows = [
+            json.loads(l)
+            for l in (tmp_path / "env.jsonl").read_text().splitlines()
+        ]
+        assert rows == [
+            {"count": "0", "reason": None},
+            {"count": "1", "reason": "preempt"},
+        ]
+        err = capsys.readouterr().err
+        assert "crash budget untouched" in err
+        assert "launch summary:" in err
+        assert f"incarnation 0: exit={PREEMPT_EXIT_CODE} (preempt)" in err
+        assert "incarnation 1: exit=0 (ok)" in err
+
+    def test_crash_reason_and_summary(self, tmp_path, capsys):
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os, sys\n"
+            f"out = {str(tmp_path / 'env.jsonl')!r}\n"
+            "with open(out, 'a') as f:\n"
+            "    f.write(os.environ.get('PADDLE_RESTART_REASON', '-')\n"
+            "            + '\\n')\n"
+            "if os.environ['PADDLE_RESTART_COUNT'] == '0':\n"
+            "    sys.exit(9)\n"
+        )
+        from paddle_tpu.distributed.launch.main import launch
+
+        code = launch([
+            "--log_dir", str(tmp_path / "logs"), "--max_restarts", "1",
+            "--restart_interval", "0.05", str(script),
+        ])
+        assert code == 0
+        lines = (tmp_path / "env.jsonl").read_text().splitlines()
+        assert lines == ["-", "crash"]
+        err = capsys.readouterr().err
+        assert "incarnation 0: exit=9 (crash)" in err
+
+    def test_preempt_loop_runaway_guard(self, tmp_path):
+        script = tmp_path / "w.py"
+        script.write_text(f"import sys; sys.exit({PREEMPT_EXIT_CODE})\n")
+        from paddle_tpu.distributed.launch.main import launch
+
+        code = launch([
+            "--log_dir", str(tmp_path / "logs"),
+            "--max_preempt_restarts", "2",
+            "--restart_interval", "0.01", str(script),
+        ])
+        assert code == PREEMPT_EXIT_CODE
+
+    def test_elastic_preempt_runaway_guard(self, tmp_path):
+        """The --elastic (multi-node) path honors
+        --max_preempt_restarts too: a node stuck exiting
+        PREEMPT_EXIT_CODE every epoch stops relaunching once the guard
+        trips, instead of respawning forever."""
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        script = tmp_path / "w.py"
+        script.write_text(f"import sys; sys.exit({PREEMPT_EXIT_CODE})\n")
+        from paddle_tpu.distributed.launch.main import launch
+
+        code = launch([
+            "--elastic", "--nnodes", "1",
+            "--master", f"127.0.0.1:{port}",
+            "--max_preempt_restarts", "2",
+            "--restart_interval", "0.01",
+            "--elastic_join_timeout", "5", "--elastic_grace", "1",
+            "--log_dir", str(tmp_path / "logs"), str(script),
+        ])
+        assert code == PREEMPT_EXIT_CODE
+
+
+# -- chaos harness: kill / preempt through the real launcher ----------------
+
+# One worker script drives all chaos variants: a tiny deterministic
+# training job under TrainLoop. CHAOS_MODE:
+#   ""        uninterrupted baseline
+#   "crash"   seeded train.step fault kills incarnation 0 mid-run
+#   "preempt" incarnation 0 SIGTERMs itself mid-step (emergency ckpt)
+#   "hang"    incarnation 0 wedges a step; the watchdog hard-exits it
+CHAOS_WORKER = """
+import os, sys, json, signal, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import paddle_tpu as paddle
+from paddle_tpu.io import BatchSampler, DataLoader, RandomSampler, \\
+    TensorDataset
+from paddle_tpu.distributed.watchdog import CommWatchdog
+from paddle_tpu.resilience import FaultSpec, TrainLoop, TrainState, faults
+
+ckpt_dir, out_path, total = sys.argv[1], sys.argv[2], int(sys.argv[3])
+mode = os.environ.get("CHAOS_MODE", "")
+incarnation = os.environ.get("PADDLE_RESTART_COUNT", "0")
+
+paddle.seed(0)
+np.random.seed(123)
+import random as pyrandom
+pyrandom.seed(321)
+model = paddle.nn.Sequential(
+    paddle.nn.Linear(4, 8), paddle.nn.Dropout(0.5), paddle.nn.Linear(8, 4)
+)
+opt = paddle.optimizer.Adam(
+    learning_rate=paddle.optimizer.lr.StepDecay(0.05, step_size=3),
+    parameters=model.parameters(),
+)
+data = np.arange(64, dtype=np.float32).reshape(16, 4) / 64.0
+ds = TensorDataset([data])
+loader = DataLoader(ds, batch_sampler=BatchSampler(
+    sampler=RandomSampler(ds, seed=7), batch_size=4))
+state = TrainState(model=model, optimizer=opt, dataloader=loader)
+
+def step_fn(batch, st):
+    if mode == "hang" and incarnation == "0" and st.step == 3:
+        time.sleep(600)  # wedged: only the watchdog hard-exit ends it
+    x = batch[0]
+    loss = ((model(x) - x) ** 2).mean()
+    loss.backward(); opt.step(); opt.clear_grad()
+    if mode == "preempt" and incarnation == "0" and st.step == 3:
+        os.kill(os.getpid(), signal.SIGTERM)  # simulated preempt notice
+    return loss
+
+prov_path = out_path + ".provenance"
+with open(prov_path, "a") as f:
+    f.write(json.dumps({
+        "count": incarnation,
+        "reason": os.environ.get("PADDLE_RESTART_REASON"),
+    }) + "\\n")
+
+watchdog = None
+if mode == "hang":
+    # the deadline must clear the FIRST step's XLA compile (1-4s on a
+    # loaded CPU box) — only the injected 600s wedge should trip it
+    watchdog = CommWatchdog(timeout=8.0, poll_interval=0.2)
+loop = TrainLoop(state, step_fn, ckpt_dir, save_every=2,
+                 watchdog=watchdog, hang_grace=0.5)
+if mode == "crash" and incarnation == "0":
+    with faults.inject({"train.step": FaultSpec(RuntimeError("chaos"),
+                                                at=4)}):
+        loop.run(total)
+else:
+    loop.run(total)
+
+np.savez(out_path, **{k: np.asarray(v.numpy())
+                      for k, v in model.state_dict().items()})
+print("final step", state.step, flush=True)
+"""
+
+TOTAL_STEPS = 10
+
+
+def _chaos_env(mode):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CHAOS_MODE"] = mode
+    env.pop("XLA_FLAGS", None)
+    for k in list(env):
+        if k.startswith("PADDLE_"):
+            env.pop(k)
+    return env
+
+
+def _run_launcher(tmp, mode, max_restarts=2, nproc=1):
+    """Run the chaos worker through the REAL elastic launcher —
+    ``launch()`` called in-process (the launcher is stdlib-light; its
+    relaunch/budget logic is identical either way, and the tier-1
+    budget cannot afford a full python+jax boot just to parse argv),
+    workers in fresh subprocesses exactly as in production; returns
+    (exit code, launcher stderr, weights path). The ``slow``
+    multi-process variant still exercises the
+    ``python -m paddle_tpu.distributed.launch`` CLI end-to-end."""
+    import contextlib
+    import io as _io
+
+    from paddle_tpu.distributed.launch.main import launch
+
+    script = tmp / f"worker_{mode or 'base'}.py"
+    script.write_text(CHAOS_WORKER)
+    out = tmp / f"weights_{mode or 'base'}.npz"
+    ckpt = tmp / f"ckpt_{mode or 'base'}"
+    saved = dict(os.environ)
+    os.environ.clear()
+    os.environ.update(_chaos_env(mode))
+    buf = _io.StringIO()
+    try:
+        with contextlib.redirect_stderr(buf):
+            code = launch([
+                f"--nproc_per_node={nproc}",
+                f"--max_restarts={max_restarts}",
+                "--restart_interval=0.1",
+                f"--log_dir={tmp}/logs_{mode or 'base'}",
+                str(script), str(ckpt), str(out), str(TOTAL_STEPS),
+            ])
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+    return code, buf.getvalue(), out
+
+
+@pytest.fixture(scope="module")
+def baseline_weights(tmp_path_factory):
+    """One uninterrupted run of the EXACT worker code, executed
+    in-process (saving a python+jax boot): the bit-exactness oracle
+    every chaos variant — each a fresh process — is compared against,
+    which makes the comparison ALSO a cross-process determinism
+    check."""
+    tmp = tmp_path_factory.mktemp("chaos_baseline")
+    out = tmp / "weights_base.npz"
+    saved_argv, saved_env = sys.argv, dict(os.environ)
+    sys.argv = ["chaos-worker", str(tmp / "ckpt_base"), str(out),
+                str(TOTAL_STEPS)]
+    os.environ["CHAOS_MODE"] = ""
+    for k in ("PADDLE_RESTART_COUNT", "PADDLE_RESTART_REASON"):
+        os.environ.pop(k, None)
+    try:
+        exec(compile(CHAOS_WORKER, "<chaos-worker>", "exec"),
+             {"__name__": "__chaos_baseline__"})
+    finally:
+        sys.argv = saved_argv
+        os.environ.clear()
+        os.environ.update(saved_env)
+    with np.load(out) as z:
+        return {k: z[k].copy() for k in z.files}
+
+
+class TestChaosHarness:
+    def test_crash_at_seeded_fault_resumes_bit_identical(
+        self, tmp_path, baseline_weights
+    ):
+        """Incarnation 0 dies at a seeded ``train.step`` fault (crash
+        budget consumed); the relaunched incarnation resumes from the
+        periodic checkpoint and the FINAL WEIGHTS ARE BIT-IDENTICAL to
+        the uninterrupted run."""
+        code, log, out = _run_launcher(tmp_path, "crash")
+        assert code == 0, log
+        with np.load(out) as z:
+            got = {k: z[k].copy() for k in z.files}
+        _assert_bit_identical(baseline_weights, got)
+        rows = [
+            json.loads(l) for l in open(str(out) + ".provenance")
+        ]
+        assert rows == [
+            {"count": "0", "reason": None},
+            {"count": "1", "reason": "crash"},
+        ]
+
+    @pytest.mark.slow  # two more worker boots; the SIGTERM→emergency
+    # ckpt and budget-free-relaunch pieces are each pinned at tier-1
+    # (test_real_sigterm_emergency_ckpt + TestLauncherPreemptProtocol)
+    def test_sigterm_emergency_ckpt_budget_free_bit_identical(
+        self, tmp_path, baseline_weights
+    ):
+        """SIGTERM mid-train: emergency checkpoint, PREEMPT exit,
+        relaunch with max_restarts=0 (budget untouched), and the
+        resumed run is still bit-identical to the baseline."""
+        code, log, out = _run_launcher(tmp_path, "preempt",
+                                       max_restarts=0)
+        assert code == 0, log
+        # worker stderr lands in the per-incarnation workerlog
+        wlog = (tmp_path / "logs_preempt" / "workerlog.0").read_text()
+        assert "emergency checkpoint saved" in wlog
+        assert "crash budget untouched" in log
+        with np.load(out) as z:
+            got = {k: z[k].copy() for k in z.files}
+        _assert_bit_identical(baseline_weights, got)
+        rows = [
+            json.loads(l) for l in open(str(out) + ".provenance")
+        ]
+        assert rows == [
+            {"count": "0", "reason": None},
+            {"count": "1", "reason": "preempt"},
+        ]
+
+    @pytest.mark.slow  # watchdog deadline + an extra jax import
+    def test_hang_watchdog_hard_exit_resumes_bit_identical(
+        self, tmp_path, baseline_weights
+    ):
+        """A wedged step (never returns) is hard-exited from the
+        watchdog thread with HANG_EXIT_CODE — a budget-consuming
+        failure, 'hang' in the launcher summary — and the relaunch
+        resumes bit-identically from the last periodic checkpoint."""
+        code, log, out = _run_launcher(tmp_path, "hang")
+        assert code == 0, log
+        assert f"exit={HANG_EXIT_CODE} (hang)" in log
+        with np.load(out) as z:
+            got = {k: z[k].copy() for k in z.files}
+        _assert_bit_identical(baseline_weights, got)
+
+    @pytest.mark.slow  # a second pod process doubles the jax imports
+    def test_multiprocess_pod_crash_resume_bit_identical(self, tmp_path):
+        """Two-worker pod: rank 1's crash tears the pod down, the
+        relaunch resumes BOTH ranks from their checkpoints, and each
+        rank's final weights are bit-identical to its own
+        uninterrupted run."""
+        script = tmp_path / "worker_mp.py"
+        # per-rank ckpt/out paths; rank 1 crashes in incarnation 0
+        script.write_text(CHAOS_WORKER.replace(
+            'ckpt_dir, out_path, total = sys.argv[1], sys.argv[2], '
+            'int(sys.argv[3])',
+            'rank = os.environ.get("PADDLE_TRAINER_ID", "0")\n'
+            'ckpt_dir = sys.argv[1] + "-r" + rank\n'
+            'out_path = sys.argv[2] + "-r" + rank\n'
+            'total = int(sys.argv[3])',
+        ).replace(
+            'if mode == "crash" and incarnation == "0":',
+            'if mode == "crash" and incarnation == "0" and rank == "1":',
+        ))
+        results = {}
+        for mode, max_restarts in (("", 0), ("crash", 2)):
+            out = tmp_path / f"w_{mode or 'base'}"
+            ckpt = tmp_path / f"c_{mode or 'base'}"
+            proc = subprocess.run(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--nproc_per_node=2", f"--max_restarts={max_restarts}",
+                 "--restart_interval=0.1",
+                 f"--log_dir={tmp_path}/logs_mp_{mode or 'base'}",
+                 str(script), str(ckpt), str(out), str(TOTAL_STEPS)],
+                env=_chaos_env(mode), cwd=str(tmp_path),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                timeout=300,
+            )
+            assert proc.returncode == 0, proc.stdout.decode()
+            results[mode] = {
+                r: np.load(f"{out}-r{r}.npz")
+                for r in ("0", "1")
+            }
+        for r in ("0", "1"):
+            base = {k: results[""][r][k] for k in results[""][r].files}
+            got = {
+                k: results["crash"][r][k]
+                for k in results["crash"][r].files
+            }
+            _assert_bit_identical(base, got)
