@@ -27,6 +27,7 @@ from .fleet import (
 )
 from .kv_cache import BlockManager, KVPool
 from .metrics import EngineMetrics
+from .prefix_cache import PrefixCache, PrefixMatch
 from .request import Request, RequestOutput, RequestState, SamplingParams
 from .supervisor import ReplicaSupervisor
 
@@ -34,6 +35,7 @@ __all__ = [
     "Engine", "EngineConfig", "EngineOverloadedError", "SamplingParams",
     "Request", "RequestOutput", "RequestState", "BlockManager", "KVPool",
     "EngineMetrics", "LlamaServingAdapter", "build_adapter",
+    "PrefixCache", "PrefixMatch",
     "Fleet", "FleetConfig", "FleetMetrics", "FleetRequest",
     "NoReplicaError", "ReplicaSupervisor",
 ]

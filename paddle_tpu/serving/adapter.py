@@ -25,7 +25,11 @@ uses the Pallas paged kernel on TPU and the XLA reference path elsewhere.
 Any object exposing the same five attributes and two methods (see
 ``required_attrs``) can serve — the engine duck-types, it never imports a
 model class. An optional ``dtype`` attribute names the KV-pool dtype;
-without it the engine reads ``weights["embed"].dtype``.
+without it the engine reads ``weights["embed"].dtype``. An optional
+third entry point, ``prefill_ext(w, kp, vp, ids, length, cache_len,
+block_table)``, continues a prefill whose first ``cache_len`` tokens
+are already in the pages — required only when the engine enables
+prefix caching or chunked prefill.
 """
 from __future__ import annotations
 
@@ -68,17 +72,42 @@ def _write_prompt_pages(pages, kv, block_table, length):
     """Scatter a prompt's [S, kv_heads, d] K or V into its pages. Token t
     lands in page ``block_table[t // block_size]`` slot ``t % block_size``;
     padded tail positions (t >= length) are routed to a nonexistent page
-    so the scatter drops them."""
+    so the scatter drops them. The degenerate (offset 0) case of
+    ``_write_chunk_pages`` — one routing implementation keeps the
+    one-shot and chunked write paths bit-identical by construction."""
+    return _write_chunk_pages(pages, kv, block_table, length, 0)
+
+
+def _write_chunk_pages(pages, kv, block_table, length, cache_len):
+    """``_write_prompt_pages`` with a position offset: chunk token t
+    lands at GLOBAL position ``cache_len + t`` (chunked prefill / cached
+    prefix continuation). Padded tail positions route out of bounds; the
+    block-table gather clamps for them, then the write is dropped."""
     n_blocks = pages.shape[1]
     block_size = pages.shape[2]
     s = kv.shape[0]
     t = jnp.arange(s)
-    phys = block_table[t // block_size]
-    phys = jnp.where(t < length, phys, n_blocks)  # OOB -> dropped
-    slot = t % block_size
+    gpos = cache_len + t
+    phys = jnp.where(t < length, block_table[gpos // block_size], n_blocks)
+    slot = gpos % block_size
     return pages.at[:, phys, slot].set(
         jnp.swapaxes(kv, 0, 1).astype(pages.dtype)
     )
+
+
+def _gather_context(pages, block_table):
+    """Materialize one sequence's logical KV timeline from its pages:
+    ``[kv_heads, blocks, bs, d]`` gathered through ``block_table [P]``
+    to ``[P*bs, kv_heads, d]`` — position p is row p. This is the
+    chunk-prefill context layout: attention over it is computed in the
+    exact ``scaled_dot_product_attention`` form the one-shot prefill
+    (and ``generate``'s cached branch) uses, which keeps chunked and
+    prefix-cached prefill BIT-identical to the one-shot program (the
+    paged-einsum form of ``paged_attention_xla`` reduces in a different
+    order and drifts by ~1 ulp — enough to flip a greedy argmax)."""
+    g = pages[:, block_table]              # [kv, P, bs, d]
+    g = jnp.moveaxis(g, 0, 2)              # [P, bs, kv, d]
+    return g.reshape(-1, g.shape[2], g.shape[3])
 
 
 class LlamaServingAdapter:
@@ -175,6 +204,60 @@ class LlamaServingAdapter:
             x = self._mlp(wl, x)
         x = _rms_norm(x, w["norm"], epsilon=self.eps)
         h_last = jnp.take(x[0], length - 1, axis=0)    # [hid]
+        return self._logits(w, h_last), tuple(kp), tuple(vp)
+
+    def prefill_ext(self, w, kp, vp, ids, length, cache_len, block_table):
+        """Prefill CONTINUATION: run one chunk of a prompt whose first
+        ``cache_len`` tokens are already in the pages (an earlier chunk,
+        or a shared prefix forked from the cache). ids [S] (padded to a
+        bucket) hold the chunk, length is its valid token count; chunk
+        token t sits at global position ``cache_len + t``. Writes the
+        chunk's K/V into the pages, attends every chunk token over the
+        gathered page timeline (cached prefix + chunk-so-far, causal),
+        and returns (logits [vocab] at the chunk's last valid position,
+        kp, vp).
+
+        Bit-parity contract: for the same tokens, any chunking of a
+        prompt through this entry point yields page contents and final
+        logits BYTE-identical to one ``prefill`` call (float32 pool;
+        see docs/serving.md for the reduced-precision-pool caveat) —
+        the attention is the same ``_sdpa`` masked form over the same
+        values, and padded/garbage context rows are exact zeros in the
+        softmax."""
+        s = ids.shape[0]
+        x = w["embed"][ids][None]                       # [1, S, hid]
+        pos = (cache_len + jnp.arange(s, dtype=jnp.int32))[None]
+        kp, vp = list(kp), list(vp)
+        capacity = block_table.shape[0] * kp[0].shape[2]
+        # keep[q, c]: context position c visible to chunk token q
+        # (causal over the global timeline; unwritten/garbage rows fall
+        # outside it and contribute exact zeros after the softmax)
+        keep = (
+            jnp.arange(capacity, dtype=jnp.int32)[None, :]
+            <= pos[0][:, None]
+        )[None, None]                                   # [1, 1, S, C]
+        for li in range(self.num_layers):
+            wl = w["layers"][li]
+            h = _rms_norm(x, wl["ln1"], epsilon=self.eps)
+            q, k, v = self._qkv(wl, h, 1, s)
+            q, k = _rope_qk(q, k, pos, base=self.rope_theta)
+            kp[li] = _write_chunk_pages(
+                kp[li], k[0], block_table, length, cache_len
+            )
+            vp[li] = _write_chunk_pages(
+                vp[li], v[0], block_table, length, cache_len
+            )
+            kc = _gather_context(kp[li], block_table)[None]  # [1, C, kv, d]
+            vc = _gather_context(vp[li], block_table)[None]
+            if self.num_kv_heads != self.num_heads:
+                rep = self.num_heads // self.num_kv_heads
+                kc = jnp.repeat(kc, rep, axis=2)
+                vc = jnp.repeat(vc, rep, axis=2)
+            attn = _sdpa(q, kc, vc, keep, is_causal=False)
+            x = x + attn.reshape(1, s, -1) @ wl["wo"]
+            x = self._mlp(wl, x)
+        x = _rms_norm(x, w["norm"], epsilon=self.eps)
+        h_last = jnp.take(x[0], length - 1, axis=0)     # [hid]
         return self._logits(w, h_last), tuple(kp), tuple(vp)
 
     def decode(self, w, kp, vp, tokens, positions, block_tables, active):

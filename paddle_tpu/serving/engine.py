@@ -18,10 +18,34 @@ pays the vocab-wide sampling warp):
     (``kv_cache.KVPool`` + per-request block tables), batched per-slot
     sampling (``sampler.sample_tokens``), one compile total.
 
+Two more program families join the set when prefix caching or chunked
+prefill is enabled (both bit-transparent to greedy outputs):
+
+  * PREFILL_EXT: the bucketed prefill signature extended with a
+    cache-length operand — continues a prompt whose first ``cache_len``
+    tokens are already in the pages (an earlier chunk, or a shared
+    prefix forked from the ``prefix_cache``), attending chunk tokens
+    over the gathered page timeline in the exact ``_sdpa`` form the
+    one-shot prefill uses (byte-identical logits and pages).
+  * COW: copy one physical block (all layers) — the copy-on-write
+    divergence step when a cache match's one-token-to-prefill cap cuts
+    into the last shared block. One compile total.
+
 Scheduling policy (host-side, cheap):
   * admission control — FCFS from the waiting queue into free slots,
     gated on KV blocks for the whole prompt plus one decode step;
-    ``max_waiting`` bounds the queue.
+    ``max_waiting`` bounds the queue. With the prefix cache enabled,
+    the longest cached prompt prefix is matched at admission and its
+    blocks are ``fork()``ed instead of allocated+recomputed; blocks
+    whose only owner is the cache are reclaimed on demand before an
+    admission is refused.
+  * chunked prefill — ``prefill_chunk_tokens`` splits the remaining
+    prompt into fixed-size chunks (padded through the same bucket set)
+    and at most ``max_prefill_chunks_per_step`` chunks run per step,
+    interleaved with the decode batch — one long prompt no longer
+    stalls every running request for its whole prefill (Sarathi-style
+    stall-free scheduling), bounding both TTFT and inter-token latency
+    under mixed traffic.
   * block growth — each decode step first ensures every running request
     owns a block for the token it is about to write; on pool exhaustion
     the YOUNGEST running request is preempted (blocks freed, request
@@ -105,7 +129,9 @@ class EngineConfig:
     def __init__(self, max_batch_slots=8, max_model_len=2048, page_size=16,
                  num_blocks=None, prefill_buckets=None, max_waiting=None,
                  seed=0, kv_shed_threshold=None, analysis_check=None,
-                 compile_cache=None):
+                 compile_cache=None, enable_prefix_cache=False,
+                 prefix_cache_blocks=None, prefill_chunk_tokens=None,
+                 max_prefill_chunks_per_step=1):
         if max_batch_slots < 1:
             raise ValueError("max_batch_slots must be >= 1")
         if page_size < 1 or max_model_len < 2:
@@ -165,6 +191,46 @@ class EngineConfig:
         # BEFORE accepting traffic, with zero fresh traces. None (the
         # default) keeps the lazy-compile behavior.
         self.compile_cache = compile_cache
+        # automatic prefix caching (serving/prefix_cache.py): share
+        # read-only prompt blocks across requests, retain them after
+        # release under an LRU budget of prefix_cache_blocks entries
+        # (None -> the whole pool is eligible)
+        self.enable_prefix_cache = bool(enable_prefix_cache)
+        if prefix_cache_blocks is not None and prefix_cache_blocks < 1:
+            raise ValueError(
+                f"prefix_cache_blocks must be >= 1 or None, got "
+                f"{prefix_cache_blocks}"
+            )
+        self.prefix_cache_blocks = (
+            int(prefix_cache_blocks) if prefix_cache_blocks is not None
+            else self.num_blocks
+        )
+        # chunked prefill: None disables (a prompt prefills in one
+        # launch, today's behavior); an int splits the remaining prompt
+        # into chunks of that many tokens, each padded through the
+        # prefill bucket set — pick a bucket size to avoid pad waste
+        if prefill_chunk_tokens is not None:
+            if prefill_chunk_tokens < 1:
+                raise ValueError(
+                    f"prefill_chunk_tokens must be >= 1 or None, got "
+                    f"{prefill_chunk_tokens}"
+                )
+            if prefill_chunk_tokens > self.prefill_buckets[-1]:
+                raise ValueError(
+                    f"prefill_chunk_tokens ({prefill_chunk_tokens}) "
+                    f"exceeds the largest prefill bucket "
+                    f"({self.prefill_buckets[-1]})"
+                )
+        self.prefill_chunk_tokens = (
+            None if prefill_chunk_tokens is None
+            else int(prefill_chunk_tokens)
+        )
+        if max_prefill_chunks_per_step < 1:
+            raise ValueError(
+                f"max_prefill_chunks_per_step must be >= 1, got "
+                f"{max_prefill_chunks_per_step}"
+            )
+        self.max_prefill_chunks_per_step = int(max_prefill_chunks_per_step)
         self.seed = int(seed)
 
 
@@ -196,6 +262,15 @@ class Engine:
             cfg.num_blocks, cfg.page_size, self.adapter.head_dim, dtype,
         )
         self.block_manager = BlockManager(cfg.num_blocks, cfg.page_size)
+        self.prefix_cache = None
+        if cfg.enable_prefix_cache:
+            from .prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(
+                self.block_manager,
+                capacity_blocks=cfg.prefix_cache_blocks,
+                metrics=self.metrics,
+            )
         self.waiting: collections.deque = collections.deque()
         self.slots: list = [None] * cfg.max_batch_slots
         # outputs for requests aborted between steps: emitted by the
@@ -280,14 +355,67 @@ class Engine:
             )
             return nxt, kp, vp
 
+        # chunked prefill / prefix-cache continuation: the bucketed
+        # prefill signature with a cache-length operand. ``any_sample``
+        # is forced False for non-final chunks host-side (their sampled
+        # token is discarded), so only the final chunk of a sampled
+        # request pays the warp.
+        def prefill_ext_fn(w, kp, vp, ids, length, cache_len, block_table,
+                           temperature, top_k, top_p, do_sample, key,
+                           any_sample):
+            metrics.prefill_ext_compiles += 1  # traced-body compile probe
+            jit_events.mark_traced()           # global compile/retrace log
+            logits, kp, vp = adapter.prefill_ext(
+                w, kp, vp, ids, length, cache_len, block_table
+            )
+            u = (
+                jax.random.uniform(
+                    key, (1,) + logits.shape, jnp.float32, 1e-9, 1.0
+                ) if any_sample else None
+            )
+            tok = sample_tokens(
+                logits[None], temperature[None], top_k[None], top_p[None],
+                do_sample[None], u,
+            )
+            return tok[0], kp, vp
+
+        # copy-on-write divergence: duplicate one physical block across
+        # every layer's pages (the partial shared block a cache match
+        # would otherwise write into)
+        def cow_fn(kp, vp, src, dst):
+            metrics.cow_compiles += 1       # traced-body compile probe
+            jit_events.mark_traced()        # global compile/retrace log
+            kp = tuple(p.at[:, dst].set(p[:, src]) for p in kp)
+            vp = tuple(p.at[:, dst].set(p[:, src]) for p in vp)
+            return kp, vp
+
         self._prefill_fn = prefill_fn   # unjitted: analysis traces these
         self._decode_fn = decode_fn
+        self._prefill_ext_fn = prefill_ext_fn
+        self._cow_fn = cow_fn
         self._prefill_jit = jax.jit(
             prefill_fn, donate_argnums=donate, static_argnums=(11,)
         )
         self._decode_jit = jax.jit(
             decode_fn, donate_argnums=donate, static_argnums=(12,)
         )
+        self._prefill_ext_jit = jax.jit(
+            prefill_ext_fn, donate_argnums=donate, static_argnums=(12,)
+        )
+        self._cow_jit = jax.jit(
+            cow_fn,
+            donate_argnums=(0, 1) if self._pool_donated else (),
+        )
+        cfg = self.config
+        self._chunking = cfg.prefill_chunk_tokens is not None
+        self._use_ext = self._chunking or cfg.enable_prefix_cache
+        if self._use_ext and not hasattr(adapter, "prefill_ext"):
+            raise TypeError(
+                f"{type(adapter).__name__} has no prefill_ext entry "
+                "point: prefix caching / chunked prefill need an "
+                "adapter that can continue a prefill at a nonzero "
+                "cache length"
+            )
         # persistent compile cache: with a cache configured, every
         # launch goes through an AOT-compiled executable held in
         # self._aot — loaded from disk on a warm restart (zero fresh
@@ -304,6 +432,8 @@ class Engine:
             self._warm_from_cache()
         if self.config.analysis_check is not None:
             self.check_decode(self.config.analysis_check)
+            if self._use_ext:
+                self.check_prefill(self.config.analysis_check)
 
     # -- persistent compile cache (paddle_tpu.compilecache) ------------------
     def _abstract_args(self, kind, bucket=None):
@@ -328,6 +458,17 @@ class Engine:
                 sds((), jnp.float32), sds((), jnp.int32),
                 sds((), jnp.float32), sds((), jnp.bool_), key,
             )
+        if kind == "prefill_ext":
+            return (
+                w, kp, vp,
+                sds((int(bucket),), jnp.int32), sds((), jnp.int32),
+                sds((), jnp.int32),  # cache_len
+                sds((cfg.pages_per_seq,), jnp.int32),
+                sds((), jnp.float32), sds((), jnp.int32),
+                sds((), jnp.float32), sds((), jnp.bool_), key,
+            )
+        if kind == "cow":
+            return (kp, vp, sds((), jnp.int32), sds((), jnp.int32))
         return (
             w, kp, vp,
             sds((n,), jnp.int32), sds((n,), jnp.int32),
@@ -361,17 +502,23 @@ class Engine:
         key = self._cc.key(name, sig)
         exe = self._cc.load_executable(key, name=name, signature=sig)
         if exe is None:
-            jitted = (
-                self._prefill_jit if kind == "prefill"
-                else self._decode_jit
-            )
-            ev_sig = (
-                f"{self.engine_id}:bucket={bucket}"
-                if kind == "prefill"
-                else f"{self.engine_id}:any_sample={any_sample}"
-            )
+            jitted = {
+                "prefill": self._prefill_jit,
+                "prefill_ext": self._prefill_ext_jit,
+                "decode": self._decode_jit,
+                "cow": self._cow_jit,
+            }[kind]
+            if kind in ("prefill", "prefill_ext"):
+                ev_sig = f"{self.engine_id}:bucket={bucket}"
+            elif kind == "decode":
+                ev_sig = f"{self.engine_id}:any_sample={any_sample}"
+            else:
+                ev_sig = self.engine_id
             with jit_events.watch(name, kind="serving", signature=ev_sig):
-                exe = jitted.lower(*aargs, any_sample).compile()
+                if kind == "cow":
+                    exe = jitted.lower(*aargs).compile()
+                else:
+                    exe = jitted.lower(*aargs, any_sample).compile()
             self._cc.store_executable(key, exe, name=name, signature=sig)
         self._aot[tag] = exe
         if self._manifest is not None:
@@ -423,6 +570,8 @@ class Engine:
             or "?",
             code_fingerprint(getattr(self.adapter, "decode", None))
             or "?",
+            code_fingerprint(getattr(self.adapter, "prefill_ext", None))
+            or "?",
         ))
         svc = (
             signature_str((
@@ -432,6 +581,8 @@ class Engine:
             + f"|slots={cfg.max_batch_slots}|mml={cfg.max_model_len}"
             + f"|page={cfg.page_size}|blocks={cfg.num_blocks}"
             + f"|buckets={cfg.prefill_buckets}"
+            + f"|chunk={cfg.prefill_chunk_tokens}"
+            + f"|pfx={int(cfg.enable_prefix_cache)}"
             + f"|code={self._adapter_code_fp}"
         )
         self._service_key = hashlib.sha256(svc.encode()).hexdigest()[:16]
@@ -446,6 +597,15 @@ class Engine:
                 self._ensure_program(
                     "prefill", bucket=b, any_sample=False
                 )
+            if self._use_ext:
+                # the enlarged program set: every bucket's continuation
+                # program, plus the COW block copy when sharing is on
+                for b in cfg.prefill_buckets:
+                    self._ensure_program(
+                        "prefill_ext", bucket=b, any_sample=False
+                    )
+                if cfg.enable_prefix_cache:
+                    self._ensure_program("cow")
             for e in replay:
                 kind, bucket = e.get("kind"), e.get("bucket")
                 if kind == "prefill" and bucket in cfg.prefill_buckets:
@@ -453,10 +613,18 @@ class Engine:
                         "prefill", bucket=bucket,
                         any_sample=e.get("any_sample", False),
                     )
+                elif (kind == "prefill_ext" and self._use_ext
+                        and bucket in cfg.prefill_buckets):
+                    self._ensure_program(
+                        "prefill_ext", bucket=bucket,
+                        any_sample=e.get("any_sample", False),
+                    )
                 elif kind == "decode":
                     self._ensure_program(
                         "decode", any_sample=e.get("any_sample", False)
                     )
+                elif kind == "cow" and cfg.enable_prefix_cache:
+                    self._ensure_program("cow")
         finally:
             self._warming = False
         self._save_manifest()  # one fsync'd rewrite for the whole set
@@ -542,6 +710,75 @@ class Engine:
             warnings.warn(msg, stacklevel=2)
         return report
 
+    def check_prefill(self, mode="error"):
+        """``check_decode``'s counterpart for the prefix-cache /
+        chunked-prefill program family: statically analyze the
+        continuation prefill (both static sampling variants) and the
+        COW block copy, asserting zero host-sync and retrace findings —
+        a chunk launch sits on the same latency-critical path as the
+        decode step. Trace-only; compile probes are restored after.
+        Returns the analysis Report."""
+        from .. import analysis
+
+        if mode not in ("warn", "error"):
+            raise ValueError(
+                f'check_prefill mode must be "warn" or "error", got '
+                f"{mode!r}"
+            )
+        cfg = self.config
+        bucket = cfg.prefill_buckets[0]
+        m = self.metrics
+        saved = (m.prefill_compiles, m.decode_compiles,
+                 m.prefill_ext_compiles, m.cow_compiles)
+        donate = (1, 2) if self._pool_donated else ()
+        report = analysis.Report()
+        seen = set()
+
+        def merge(variant):
+            for f in variant.findings:
+                key = (f.rule, f.file, f.line, f.message)
+                if key not in seen:  # shared-path findings once
+                    seen.add(key)
+                    report.add(f)
+
+        try:
+            for any_sample in (False, True):
+                merge(analysis.check(
+                    self._prefill_ext_fn,
+                    self.adapter.weights, self.pool.k, self.pool.v,
+                    np.zeros(bucket, np.int32), np.int32(1), np.int32(0),
+                    np.zeros(cfg.pages_per_seq, np.int32),
+                    np.float32(1.0), np.int32(0), np.float32(1.0),
+                    np.bool_(any_sample), self._base_key, any_sample,
+                    static_argnums=(12,), donate_argnums=donate,
+                    mode=mode,
+                ))
+            if cfg.enable_prefix_cache:
+                merge(analysis.check(
+                    self._cow_fn, self.pool.k, self.pool.v,
+                    np.int32(0), np.int32(1),
+                    donate_argnums=(0, 1) if self._pool_donated else (),
+                    mode=mode,
+                ))
+        finally:
+            (m.prefill_compiles, m.decode_compiles,
+             m.prefill_ext_compiles, m.cow_compiles) = saved
+        blocking = report.by_rule("host-sync") + report.by_rule(
+            "retrace-hazard"
+        )
+        if blocking:
+            msg = (
+                "serving prefill continuation failed static analysis "
+                "(the chunked-prefill latency invariant):\n"
+                + "\n".join(f.render() for f in blocking)
+            )
+            if mode == "error":
+                raise analysis.AnalysisError(msg, report)
+            import warnings
+
+            warnings.warn(msg, stacklevel=2)
+        return report
+
     def _next_key(self):
         self._key_counter += 1
         return jax.random.fold_in(self._base_key, self._key_counter)
@@ -572,11 +809,11 @@ class Engine:
             )
         if cfg.kv_shed_threshold is not None:
             bm = self.block_manager
-            util = bm.utilization()
+            reclaimable, util = self._active_pressure()
             admissible_now = (
                 not self.waiting and None in self.slots
-                and bm.can_allocate(
-                    bm.blocks_needed(len(req.prompt_token_ids) + 1)
+                and bm.num_free + reclaimable >= bm.blocks_needed(
+                    len(req.prompt_token_ids) + 1
                 )
             )
             if util >= cfg.kv_shed_threshold and not admissible_now:
@@ -596,6 +833,20 @@ class Engine:
         self.waiting.append(req)
         self.metrics.requests_received += 1
         return req
+
+    def _active_pressure(self):
+        """``(reclaimable_blocks, active_utilization)`` — the pressure
+        split every consumer (shedding, health, metrics gauges) must
+        agree on: cached prefix blocks nobody runs against are
+        RECLAIMABLE capacity, not pressure, so a pool kept warm by the
+        prefix cache neither sheds admissions nor reads as
+        overloaded."""
+        bm = self.block_manager
+        reclaimable = (
+            self.prefix_cache.reclaimable_blocks()
+            if self.prefix_cache is not None else 0
+        )
+        return reclaimable, (bm.num_used - reclaimable) / bm.num_blocks
 
     def resume(self, req):
         """Re-enqueue a request whose KV state was lost OUTSIDE the
@@ -698,9 +949,13 @@ class Engine:
         try:
             self._expire(finished)
             self._admit(finished)
-            if any(r is not None for r in self.slots):
+            self._prefill_chunks(finished)
+            running = RequestState.RUNNING
+            if any(r is not None and r.state is running
+                   for r in self.slots):
                 self._ensure_capacity()
-                if any(r is not None for r in self.slots):
+                if any(r is not None and r.state is running
+                       for r in self.slots):
                     self._decode(finished)
         except Exception as e:
             _flight.record(
@@ -723,6 +978,11 @@ class Engine:
         m.queue_depth = len(self.waiting)
         m.num_running = sum(r is not None for r in self.slots)
         m.cache_utilization = bm.utilization()
+        m.kv_reclaimable_blocks, m.kv_active_utilization = (
+            self._active_pressure()
+        )
+        if self.prefix_cache is not None:
+            m.prefix_cache_blocks = len(self.prefix_cache)
         m.pool_high_water = bm.high_water
         return finished
 
@@ -739,13 +999,17 @@ class Engine:
         m, bm, cfg = self.metrics, self.block_manager, self.config
         wd = get_comm_watchdog()
         util = bm.utilization()
+        # pressure is judged on ACTIVE utilization (_active_pressure):
+        # reclaimable cached prefix blocks are capacity the engine can
+        # take back at will, not an overloaded replica
+        reclaimable, util_active = self._active_pressure()
         queue_full = (
             cfg.max_waiting is not None
             and len(self.waiting) >= cfg.max_waiting
         )
         shedding = (
             cfg.kv_shed_threshold is not None
-            and util >= cfg.kv_shed_threshold
+            and util_active >= cfg.kv_shed_threshold
         )
         degraded = bool(
             m.requests_errored or m.requests_timeout
@@ -767,6 +1031,12 @@ class Engine:
             "queue_depth": len(self.waiting),
             "num_running": sum(r is not None for r in self.slots),
             "kv_utilization": util,
+            "kv_active_utilization": util_active,
+            "kv_reclaimable_blocks": reclaimable,
+            "prefix_cache_blocks": (
+                len(self.prefix_cache)
+                if self.prefix_cache is not None else 0
+            ),
             "requests_errored": m.requests_errored,
             "requests_timeout": m.requests_timeout,
             "requests_shed": m.requests_shed,
@@ -801,33 +1071,74 @@ class Engine:
         self._finish(req, "error", finished)
 
     def _admit(self, finished):
-        cfg, bm = self.config, self.block_manager
+        """FCFS admission into free slots. A request is admitted with
+        its FULL block budget (whole prompt plus one decode write) but
+        no compute: the prefix cache may cover a prefix via ``fork()``
+        (copy-on-write when the one-token cap cuts into the last shared
+        block), and the actual prefill runs in :meth:`_prefill_chunks`
+        — one launch, or several interleaved chunk launches."""
+        bm = self.block_manager
         while self.waiting and None in self.slots:
             req = self.waiting[0]
             tokens = req.tokens_to_prefill()
-            # admission control: the whole prompt plus the next decode
-            # write must fit, or the request stays queued (FCFS)
-            if not bm.can_allocate(bm.blocks_needed(len(tokens) + 1)):
-                break
+            match = None
+            if self.prefix_cache is not None:
+                # at least one token must remain to prefill: its logits
+                # seed the first sampled token
+                match = self.prefix_cache.lookup(
+                    tokens, limit=len(tokens) - 1
+                )
+            n_fork = match.num_shared if match is not None else 0
+            n_alloc = bm.blocks_needed(len(tokens) + 1) - n_fork
+            if not bm.can_allocate(n_alloc):
+                if self.prefix_cache is not None:
+                    # retained cache blocks are reclaimable capacity —
+                    # but never the ones this very match is about to
+                    # fork or copy from
+                    protect = set(
+                        match.shared_blocks
+                    ) if match is not None else set()
+                    if match is not None and match.cow_src is not None:
+                        protect.add(match.cow_src)
+                    self.prefix_cache.reclaim(
+                        n_alloc - bm.num_free, protect=protect
+                    )
+                if not bm.can_allocate(n_alloc):
+                    break
             self.waiting.popleft()
-            req.block_ids = bm.allocate(bm.blocks_needed(len(tokens) + 1))
+            if self.prefix_cache is not None:
+                # one lookup per ADMISSION (blocked retries don't count;
+                # neither do they touch the LRU — see lookup/commit)
+                self.metrics.prefix_lookups += 1
+            if match is not None:
+                bm.fork(match.shared_blocks)
+                req.block_ids = list(match.shared_blocks) + bm.allocate(
+                    n_alloc
+                )
+                req.num_cached = match.cache_len
+                self.prefix_cache.commit(match)
+            else:
+                req.block_ids = bm.allocate(n_alloc)
+                req.num_cached = 0
             req.slot = self.slots.index(None)
             self.slots[req.slot] = req
-            req.state = RequestState.RUNNING
+            req.state = RequestState.PREFILLING
             req.admit_seq = self._admit_counter
             self._admit_counter += 1
-            try:
-                self._prefill(req, tokens)
-            except CommTimeoutError:
-                raise  # cluster-level abort, not a poison request
-            except Exception as e:
-                if getattr(e, "_kv_pool_unsafe", False):
-                    raise  # donated pool may be gone (see _prefill)
-                self._poison(req, e, finished)
-                continue
-            reason = req.check_stop(cfg.max_model_len)
-            if reason:
-                self._finish(req, reason, finished)
+            if match is not None and match.cow_src is not None:
+                # the cap cut into the last shared block: this request
+                # will WRITE its final prefill token there, so it gets
+                # a private copy (block index n_fork is freshly
+                # allocated) instead of a fork
+                try:
+                    self._cow(match.cow_src, req.block_ids[n_fork])
+                except CommTimeoutError:
+                    raise  # cluster-level abort, not a poison request
+                except Exception as e:
+                    if getattr(e, "_kv_pool_unsafe", False):
+                        raise  # donated pool may be gone
+                    self._poison(req, e, finished)
+                    continue
 
     def _watch(self, tag):
         """Hung-step detection: launches run under the comm watchdog
@@ -892,6 +1203,11 @@ class Engine:
         req.num_cached = len(tokens)
         self.metrics.prefill_tokens += len(tokens)
         self.metrics.prefill_steps += 1
+        self._finish_prefill(req, tok)
+
+    def _finish_prefill(self, req, tok):
+        """Book the first token once a request's whole prefill has
+        landed (one-shot or final chunk)."""
         if req.output_token_ids:
             # resumed after preemption: the sampled token re-derives
             # output[-1]; keep the one we already have
@@ -903,6 +1219,145 @@ class Engine:
             )
             req.output_token_ids.append(tok)
             req.last_token = tok
+
+    def _prefill_chunks(self, finished):
+        """Run prefill launches for PREFILLING slot occupants, oldest
+        first. Chunking disabled: every pending prefill completes this
+        step (one launch each — the pre-chunking behavior). Chunking
+        enabled: at most ``max_prefill_chunks_per_step`` chunk launches
+        run, then the decode batch gets the step — a long prompt is
+        spread over steps instead of stalling every running request."""
+        cfg = self.config
+        budget = (
+            cfg.max_prefill_chunks_per_step if self._chunking else None
+        )
+        used = 0
+        for req in sorted(
+            (r for r in self.slots
+             if r is not None and r.state is RequestState.PREFILLING),
+            key=lambda r: r.admit_seq,
+        ):
+            while req.state is RequestState.PREFILLING:
+                if budget is not None and used >= budget:
+                    return
+                used += 1
+                tokens = req.tokens_to_prefill()
+                remaining = tokens[req.num_cached:]
+                chunk = (
+                    remaining[:cfg.prefill_chunk_tokens]
+                    if self._chunking else remaining
+                )
+                final = req.num_cached + len(chunk) >= len(tokens)
+                try:
+                    if req.num_cached == 0 and final:
+                        # nothing cached, everything fits: the classic
+                        # one-shot program (bit-for-bit today's path)
+                        self._prefill(req, tokens)
+                    else:
+                        self._prefill_chunk(req, chunk, final)
+                except CommTimeoutError:
+                    raise  # cluster-level abort, not a poison request
+                except Exception as e:
+                    if getattr(e, "_kv_pool_unsafe", False):
+                        raise  # donated pool may be gone
+                    self._poison(req, e, finished)
+                    break
+                if final:
+                    req.state = RequestState.RUNNING
+                    if self.prefix_cache is not None:
+                        # publish the full PROMPT blocks for reuse
+                        # (decode never writes them again: writes only
+                        # land at positions >= the prompt length)
+                        self.prefix_cache.register(
+                            req.prompt_token_ids, req.block_ids,
+                            req.num_cached,
+                        )
+                    reason = req.check_stop(cfg.max_model_len)
+                    if reason:
+                        self._finish(req, reason, finished)
+
+    def _prefill_chunk(self, req, chunk, final):
+        """One continuation launch: ``chunk`` tokens appended at cache
+        position ``req.num_cached`` through the PREFILL_EXT program.
+        Non-final chunks run the greedy-only variant regardless of the
+        request's sampling params — their sampled token is discarded,
+        so the vocab warp would be wasted compute."""
+        faults.fire(
+            "serving.step", phase="prefill", request_id=req.request_id,
+        )
+        cfg = self.config
+        bucket = next_bucket(len(chunk), cfg.prefill_buckets)
+        ids = np.zeros(bucket, np.int32)
+        ids[: len(chunk)] = chunk
+        table = np.zeros(cfg.pages_per_seq, np.int32)
+        table[: len(req.block_ids)] = req.block_ids
+        p = req.sampling_params
+        cache_len = req.num_cached
+        any_sample = bool(p.do_sample) and final
+        with span(
+            "serving.prefill_ext", request_id=req.request_id,
+            bucket=bucket, cache_len=cache_len,
+        ), self._watch("serving.prefill"), jit_events.watch(
+            "serving.prefill_ext", kind="serving",
+            signature=f"{self.engine_id}:bucket={bucket}",
+        ):
+            try:
+                args = (
+                    self.adapter.weights, self.pool.k, self.pool.v,
+                    ids, np.int32(len(chunk)), np.int32(cache_len),
+                    table,
+                    np.float32(p.temperature), np.int32(p.top_k),
+                    np.float32(p.top_p), np.bool_(p.do_sample),
+                    self._next_key(),
+                )
+                if self._cc is not None:
+                    exe = self._ensure_program(
+                        "prefill_ext", bucket=bucket,
+                        any_sample=any_sample,
+                    )
+                    tok, k, v = exe(*args)
+                else:
+                    tok, k, v = self._prefill_ext_jit(*args, any_sample)
+            except Exception as e:
+                # same donated-buffer hazard as decode (_launch_decode)
+                if self._pool_donated:
+                    e._kv_pool_unsafe = True
+                raise
+            if final:
+                tok = int(tok)
+        self.pool.rebind(k, v)
+        req.num_cached = cache_len + len(chunk)
+        self.metrics.prefill_tokens += len(chunk)
+        self.metrics.prefill_steps += 1
+        self.metrics.prefill_chunks += 1
+        if final:
+            self._finish_prefill(req, tok)
+
+    def _cow(self, src, dst):
+        """Copy-on-write one physical block (every layer's pages) so a
+        prefill can diverge from a shared partial block without
+        touching the original."""
+        with span(
+            "serving.cow", src=int(src), dst=int(dst),
+        ), self._watch("serving.cow"), jit_events.watch(
+            "serving.cow", kind="serving", signature=self.engine_id,
+        ):
+            try:
+                args = (
+                    self.pool.k, self.pool.v, np.int32(src),
+                    np.int32(dst),
+                )
+                if self._cc is not None:
+                    exe = self._ensure_program("cow")
+                    k, v = exe(*args)
+                else:
+                    k, v = self._cow_jit(*args)
+            except Exception as e:
+                if self._pool_donated:
+                    e._kv_pool_unsafe = True
+                raise
+        self.pool.rebind(k, v)
+        self.metrics.cow_copies += 1
 
     def _ensure_capacity(self):
         """Every running request needs a block for the KV slot its next
@@ -919,6 +1374,9 @@ class Engine:
                 if bm.can_allocate(1):
                     req.block_ids += bm.allocate(1)
                     continue
+                if (self.prefix_cache is not None
+                        and self.prefix_cache.reclaim(1)):
+                    continue  # cached block freed: retry the allocate
                 victims = [
                     r for r in self.slots
                     if r is not None and r is not req
@@ -947,7 +1405,10 @@ class Engine:
         # greedy rows never consume it, and sampled rows see the same
         # uniforms whether or not a poison request was carved out
         key = self._next_key()
-        idxs = [i for i, r in enumerate(self.slots) if r is not None]
+        idxs = [
+            i for i, r in enumerate(self.slots)
+            if r is not None and r.state is RequestState.RUNNING
+        ]
         self._decode_subset(idxs, key, finished)
 
     def _launch_decode(self, idxs, key):

@@ -189,11 +189,34 @@ def _register_view(fleet):
         restarts = MetricFamily(
             "paddle_tpu_fleet_replica_restarts_total", "counter",
         )
+        # per-replica KV/prefix-cache economics: hit tokens saved,
+        # computed prefill tokens, and reclaimable (cached, idle)
+        # blocks — the router-facing split of pool pressure
+        pfx_hits = MetricFamily(
+            "paddle_tpu_fleet_replica_prefix_hits_total", "counter",
+        )
+        pfx_tokens = MetricFamily(
+            "paddle_tpu_fleet_replica_prefix_hit_tokens_total",
+            "counter",
+        )
+        pfill = MetricFamily(
+            "paddle_tpu_fleet_replica_prefill_tokens_total", "counter",
+        )
+        reclaimable = MetricFamily(
+            "paddle_tpu_fleet_replica_kv_reclaimable_blocks", "gauge",
+        )
         for sup in fl.replicas:
             rl = {**label, "replica": sup.name}
             up.add(1.0 if sup.status == "healthy" else 0.0, rl)
             restarts.add(sup.restarts, rl)
-        fams += [up, restarts]
+            eng = sup.engine
+            if eng is not None:
+                em = eng.metrics
+                pfx_hits.add(em.prefix_hits, rl)
+                pfx_tokens.add(em.prefix_hit_tokens, rl)
+                pfill.add(em.prefill_tokens, rl)
+                reclaimable.add(em.kv_reclaimable_blocks, rl)
+        fams += [up, restarts, pfx_hits, pfx_tokens, pfill, reclaimable]
         return fams
 
     get_registry().register_collector(name, collect)

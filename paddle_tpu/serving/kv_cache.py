@@ -55,6 +55,12 @@ class BlockManager:
         """Blocks required to hold ``num_tokens`` cache slots."""
         return -(-int(num_tokens) // self.block_size)
 
+    def ref_count(self, block_id):
+        """Current reference count of one block (0 == free). The prefix
+        cache uses this to tell reclaimable cached blocks (cache is the
+        only owner) from blocks live requests still read."""
+        return self._ref[block_id]
+
     def can_allocate(self, n):
         return len(self._free) >= n
 
@@ -106,11 +112,37 @@ class KVPool:
         self.num_layers = num_layers
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
+        self._shape = shape
+        self._dtype = self.k[0].dtype
 
     def rebind(self, k, v):
-        """Adopt the updated pool arrays returned by a compiled step."""
-        self.k = tuple(k)
-        self.v = tuple(v)
+        """Adopt the updated pool arrays returned by a compiled step.
+
+        Validates that the adopted arrays actually ARE this pool's
+        layout — per-layer count, page shape, and dtype — instead of
+        silently adopting a mismatched tree (which would surface much
+        later as garbage attention reads or a shape error inside a
+        compiled step)."""
+        k, v = tuple(k), tuple(v)
+        if len(k) != self.num_layers or len(v) != self.num_layers:
+            raise ValueError(
+                f"rebind: expected {self.num_layers} k/v layers, got "
+                f"{len(k)}/{len(v)}"
+            )
+        for name, layers in (("k", k), ("v", v)):
+            for li, a in enumerate(layers):
+                if tuple(a.shape) != self._shape:
+                    raise ValueError(
+                        f"rebind: {name}[{li}] shape {tuple(a.shape)} "
+                        f"does not match pool page shape {self._shape}"
+                    )
+                if a.dtype != self._dtype:
+                    raise ValueError(
+                        f"rebind: {name}[{li}] dtype {a.dtype} does not "
+                        f"match pool dtype {self._dtype}"
+                    )
+        self.k = k
+        self.v = v
 
     def nbytes(self):
         return sum(a.size * a.dtype.itemsize for a in self.k + self.v)

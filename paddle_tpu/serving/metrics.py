@@ -35,12 +35,23 @@ _EXPORT_KINDS = {
     "prefill_tokens": ("counter", "_total"),
     "decode_tokens": ("counter", "_total"),
     "prefill_steps": ("counter", "_total"),
+    "prefill_chunks": ("counter", "_total"),
     "decode_steps": ("counter", "_total"),
     "prefill_compiles": ("counter", "_total"),
+    "prefill_ext_compiles": ("counter", "_total"),
     "decode_compiles": ("counter", "_total"),
+    "cow_compiles": ("counter", "_total"),
+    "prefix_lookups": ("counter", "_total"),
+    "prefix_hits": ("counter", "_total"),
+    "prefix_hit_tokens": ("counter", "_total"),
+    "prefix_evictions": ("counter", "_total"),
+    "cow_copies": ("counter", "_total"),
     "queue_depth": ("gauge", ""),
     "num_running": ("gauge", ""),
     "cache_utilization": ("gauge", ""),
+    "kv_active_utilization": ("gauge", ""),
+    "kv_reclaimable_blocks": ("gauge", ""),
+    "prefix_cache_blocks": ("gauge", ""),
     "pool_high_water": ("gauge", ""),
     "mean_ttft_s": ("gauge", ""),
     "tokens_per_s": ("gauge", ""),
@@ -88,19 +99,39 @@ class EngineMetrics:
         self.requests_timeout = 0
         self.requests_shed = 0
         self.last_error = None
-        # token flow
+        # token flow: prefill_tokens counts tokens actually COMPUTED by
+        # a prefill launch — prefix-cache hits subtract from it, which
+        # is exactly the saving the hit-tokens counter measures
         self.prefill_tokens = 0
         self.decode_tokens = 0
+        # prefix cache (serving/prefix_cache.py): lookups/hits at
+        # admission, hit_tokens = prompt tokens served from shared
+        # blocks instead of recomputed, cow_copies = partial-block
+        # copy-on-write divergences, evictions = cached blocks released
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_evictions = 0
+        self.cow_copies = 0
         # step/compile accounting (compile counters are bumped from INSIDE
         # the traced step body, so they move only when XLA retraces)
         self.prefill_steps = 0
+        self.prefill_chunks = 0   # chunk launches via prefill_ext
         self.decode_steps = 0
         self.prefill_compiles = 0
+        self.prefill_ext_compiles = 0
         self.decode_compiles = 0
+        self.cow_compiles = 0
         # gauges (updated by the engine each step)
         self.queue_depth = 0
         self.num_running = 0
         self.cache_utilization = 0.0
+        # KV pressure split: active excludes reclaimable-cached blocks
+        # (retained prefix blocks nobody is running against) — shedding
+        # and routing must see THIS, not raw utilization
+        self.kv_active_utilization = 0.0
+        self.kv_reclaimable_blocks = 0
+        self.prefix_cache_blocks = 0
         self.pool_high_water = 0
         # latency
         self._ttft_sum = 0.0
@@ -140,11 +171,22 @@ class EngineMetrics:
             "num_running": self.num_running,
             "prefill_tokens": self.prefill_tokens,
             "decode_tokens": self.decode_tokens,
+            "prefix_lookups": self.prefix_lookups,
+            "prefix_hits": self.prefix_hits,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefix_evictions": self.prefix_evictions,
+            "cow_copies": self.cow_copies,
             "prefill_steps": self.prefill_steps,
+            "prefill_chunks": self.prefill_chunks,
             "decode_steps": self.decode_steps,
             "prefill_compiles": self.prefill_compiles,
+            "prefill_ext_compiles": self.prefill_ext_compiles,
             "decode_compiles": self.decode_compiles,
+            "cow_compiles": self.cow_compiles,
             "cache_utilization": self.cache_utilization,
+            "kv_active_utilization": self.kv_active_utilization,
+            "kv_reclaimable_blocks": self.kv_reclaimable_blocks,
+            "prefix_cache_blocks": self.prefix_cache_blocks,
             "pool_high_water": self.pool_high_water,
             "mean_ttft_s": self.mean_ttft,
             "tokens_per_s": self.tokens_per_second(),

@@ -31,8 +31,12 @@ def normalize_sampling_params(prompts, sampling_params):
 
 class RequestState(enum.Enum):
     WAITING = 0     # queued (never scheduled, or preempted back to queue)
-    RUNNING = 1     # owns a batch slot + KV blocks
+    RUNNING = 1     # owns a batch slot + KV blocks, decoding
     FINISHED = 2
+    # owns a slot + blocks but its prompt is still being prefilled
+    # (chunked prefill spreads the prompt over several steps); excluded
+    # from the decode batch until the final chunk samples its token
+    PREFILLING = 3
 
 
 class SamplingParams:

@@ -564,6 +564,153 @@ class TestFailurePaths:
         ]
 
 
+PFX_CFG = dict(
+    max_batch_slots=2, max_model_len=32, page_size=4,
+    prefill_buckets=[8, 32], enable_prefix_cache=True,
+    prefill_chunk_tokens=8,
+)
+
+
+@pytest.fixture(scope="module")
+def warm_pfx_cache(model, tmp_path_factory):
+    """One cold build of the ENLARGED program set (prefix caching +
+    chunked prefill: prefill + prefill_ext per bucket, decode, COW),
+    shared by the warm-restart and warm-CLI tests."""
+    root = tmp_path_factory.mktemp("ccpfx")
+    eng = Engine(model, _engine_config(root, **PFX_CFG))
+    cold = _tokens(eng)
+    m = eng.metrics
+    assert m.prefill_compiles >= 1
+    assert m.prefill_ext_compiles >= 1
+    assert m.decode_compiles == 1
+    assert m.cow_compiles == 1
+    return str(root), cold
+
+
+class TestPrefixCacheWarmRestart:
+    """The enlarged program set (prefix cache + chunked prefill) joins
+    the manifest and replays on a warm restart with zero fresh
+    traces."""
+
+    def test_manifest_covers_enlarged_program_set(self, warm_pfx_cache):
+        root, _ = warm_pfx_cache
+        mdir = os.path.join(root, "manifests")
+        (mname,) = os.listdir(mdir)
+        with open(os.path.join(mdir, mname)) as f:
+            entries = json.load(f)["entries"]
+        kinds = sorted(set(e["kind"] for e in entries))
+        assert kinds == ["cow", "decode", "prefill", "prefill_ext"]
+        ext_buckets = sorted(
+            e["bucket"] for e in entries if e["kind"] == "prefill_ext"
+        )
+        assert ext_buckets == [8, 32]
+        store = ArtifactStore(root)
+        for e in entries:
+            assert store.contains(e["store_key"])
+
+    def test_warm_restart_replays_enlarged_set_zero_traces(
+        self, model, warm_pfx_cache
+    ):
+        root, cold = warm_pfx_cache
+        hits0 = jit_events.aot_hits()
+        eng = Engine(model, _engine_config(root, **PFX_CFG))
+        m = eng.metrics
+        probe = (m.prefill_compiles, m.prefill_ext_compiles,
+                 m.decode_compiles, m.cow_compiles)
+        assert probe == (0, 0, 0, 0)
+        assert jit_events.aot_hits() >= hits0 + 6  # 2+2 prefill, decode, cow
+        # serving through the warm programs: bit-identical, still zero
+        # traces — cache hits, chunked prefill and COW all replay AOT
+        assert _tokens(eng) == cold
+        assert _tokens(eng) == cold   # second pass: prefix-cache hits
+        assert eng.metrics.prefix_hit_tokens > 0
+        assert eng.metrics.cow_copies >= 1
+        probe = (m.prefill_compiles, m.prefill_ext_compiles,
+                 m.decode_compiles, m.cow_compiles)
+        assert probe == (0, 0, 0, 0)
+
+
+class TestWarmCLI:
+    """``python -m paddle_tpu.compilecache warm --manifest <path>``:
+    pre-populate / verify a fleet's cache ahead of deploy."""
+
+    def _manifest_path(self, root):
+        mdir = os.path.join(root, "manifests")
+        (mname,) = os.listdir(mdir)
+        return os.path.join(mdir, mname)
+
+    def test_warm_verifies_full_cache(self, warm_pfx_cache, capsys):
+        from paddle_tpu.compilecache.__main__ import main
+
+        root, _ = warm_pfx_cache
+        assert main(["warm", "--manifest", self._manifest_path(root)]) == 0
+        out = capsys.readouterr().out
+        assert "6/6 programs present" in out
+
+    def test_warm_reports_missing_without_builder(
+        self, warm_pfx_cache, tmp_path, capsys
+    ):
+        from paddle_tpu.compilecache.__main__ import main
+
+        root, _ = warm_pfx_cache
+        dst = str(tmp_path / "cache")
+        shutil.copytree(root, dst)
+        mpath = self._manifest_path(dst)
+        with open(mpath) as f:
+            entries = json.load(f)["entries"]
+        (decode,) = [e for e in entries if e["kind"] == "decode"]
+        ArtifactStore(dst).remove(decode["store_key"])
+        assert main(["warm", "--manifest", mpath]) == 3
+        out = capsys.readouterr().out
+        assert "MISSING" in out and "5/6 programs present" in out
+
+    def test_warm_builder_compiles_missing_entries(
+        self, warm_pfx_cache, tmp_path, monkeypatch, capsys
+    ):
+        """With --builder, a partially-populated cache is completed:
+        the builder constructs the service's engine against the cache
+        (warm for everything present), and only the missing program
+        compiles fresh and is re-persisted."""
+        import sys as _sys
+
+        from paddle_tpu.compilecache.__main__ import main
+
+        root, _ = warm_pfx_cache
+        dst = str(tmp_path / "cache")
+        shutil.copytree(root, dst)
+        mpath = self._manifest_path(dst)
+        with open(mpath) as f:
+            entries = json.load(f)["entries"]
+        (cow,) = [e for e in entries if e["kind"] == "cow"]
+        ArtifactStore(dst).remove(cow["store_key"])
+        # the builder module a deploy pipeline would ship: rebuilds the
+        # service's engine (same model identity + config -> same
+        # service key) against the cache directory it is handed
+        (tmp_path / "pfx_warm_builder.py").write_text(
+            "import paddle_tpu as paddle\n"
+            "from paddle_tpu.models.llama import LlamaConfig, "
+            "LlamaForCausalLM\n"
+            "from paddle_tpu.serving import Engine, EngineConfig\n"
+            f"CFG = {PFX_CFG!r}\n"
+            "def build(cache_dir):\n"
+            "    paddle.seed(0)\n"
+            "    model = LlamaForCausalLM(LlamaConfig.tiny())\n"
+            "    Engine(model, EngineConfig(compile_cache=cache_dir, "
+            "**CFG))\n"
+        )
+        monkeypatch.syspath_prepend(str(tmp_path))
+        _sys.modules.pop("pfx_warm_builder", None)
+        rc = main([
+            "warm", "--manifest", mpath,
+            "--builder", "pfx_warm_builder:build",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "1/6 program(s) missing" in out
+        assert "6/6 programs present" in out
+        assert ArtifactStore(dst).contains(cow["store_key"])
+
+
 class TestFleetWarmRestart:
     def test_rolling_restart_replays_manifest(self, model, warm_cache):
         root, cold = warm_cache

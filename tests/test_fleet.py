@@ -127,6 +127,21 @@ class TestFailover:
             f"{{fleet={fleet.fleet_id}}}"
         )
         assert snap[key] == 1
+        # per-replica KV/prefix-cache stats ride in the same collector
+        # view (hit counters are 0 here — the config has no prefix
+        # cache — but the series exist per replica for the router)
+        survivor = (
+            f"{{fleet={fleet.fleet_id},replica=r1}}"
+        )
+        assert snap[
+            "paddle_tpu_fleet_replica_prefill_tokens_total" + survivor
+        ] > 0
+        assert snap[
+            "paddle_tpu_fleet_replica_prefix_hit_tokens_total" + survivor
+        ] == 0
+        assert snap[
+            "paddle_tpu_fleet_replica_kv_reclaimable_blocks" + survivor
+        ] == 0
 
         # postmortem: the replica death dumped the flight ring, and the
         # ring contains the failover events for the re-enqueued work

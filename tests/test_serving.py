@@ -114,28 +114,32 @@ class TestSamplingParams:
             np.testing.assert_allclose(batched[i], row[0], rtol=1e-6)
 
 
+def _mixed_workload(n_req=32):
+    """The acceptance workload: heterogeneous (prompt, output) lengths
+    drawn from few DISTINCT combos, all with prompt+new = 16: the
+    one-at-a-time oracle compiles one generate program per distinct
+    (prompt_len, prompt_len+max_new) pair (~2s each), which would
+    otherwise dominate the test. The ENGINE is combo-blind either way —
+    its decode step never recompiles (asserted below)."""
+    rng = np.random.default_rng(42)
+    lens = [int(n) for n in rng.choice([4, 7, 10, 13], n_req)]
+    prompts = [rng.integers(1, 128, n).tolist() for n in lens]
+    max_new = [16 - n for n in lens]
+    # seeded arrival schedule: 8 up front, the rest join mid-flight
+    arrivals = sorted(
+        [0] * 8 + rng.integers(1, 20, n_req - 8).tolist()
+    )
+    return prompts, max_new, arrivals
+
+
 class TestMixedWorkload:
-    """The acceptance workload: 32 heterogeneous requests, 4 slots,
-    staggered (seeded) arrivals, pool smaller than aggregate demand."""
+    """32 heterogeneous requests, 4 slots, staggered (seeded) arrivals,
+    pool smaller than aggregate demand."""
 
     N_REQ = 32
 
     def _workload(self):
-        rng = np.random.default_rng(42)
-        # heterogeneous (prompt, output) lengths drawn from few DISTINCT
-        # combos, all with prompt+new = 16: the one-at-a-time oracle
-        # compiles one generate program per distinct (prompt_len,
-        # prompt_len+max_new) pair (~2s each), which would otherwise
-        # dominate the test. The ENGINE is combo-blind either way — its
-        # decode step never recompiles (asserted below).
-        lens = [int(n) for n in rng.choice([4, 7, 10, 13], self.N_REQ)]
-        prompts = [rng.integers(1, 128, n).tolist() for n in lens]
-        max_new = [16 - n for n in lens]
-        # seeded arrival schedule: 8 up front, the rest join mid-flight
-        arrivals = sorted(
-            [0] * 8 + rng.integers(1, 20, self.N_REQ - 8).tolist()
-        )
-        return prompts, max_new, arrivals
+        return _mixed_workload(self.N_REQ)
 
     def test_mixed_workload_parity_and_fixed_shapes(self, model):
         prompts, max_new, arrivals = self._workload()
@@ -529,3 +533,353 @@ class TestGracefulDegradation:
             assert h["watchdog"]["enabled"] and h["status"] == "ok"
         finally:
             disable_comm_watchdog()
+
+
+@pytest.fixture(scope="module")
+def prefix_engine(model):
+    """Shared engine with automatic prefix caching AND chunked prefill
+    on — the whole class drains between tests, so only counters and
+    retained cache blocks persist (deltas are asserted, never
+    absolutes). Program set: 3 prefill + 3 prefill_ext buckets, one
+    decode, one COW — the compile probes below hold cumulatively."""
+    return Engine(model, EngineConfig(
+        max_batch_slots=4, max_model_len=32, page_size=4,
+        num_blocks=96,   # headroom: active demand (<=32) + retained cache
+        prefill_buckets=[8, 16, 32],
+        enable_prefix_cache=True, prefill_chunk_tokens=8,
+        max_prefill_chunks_per_step=1, seed=3,
+    ))
+
+
+class TestPrefixCacheChunkedPrefill:
+    """Tentpole acceptance: automatic prefix caching + chunked prefill
+    stay BYTE-identical to ``generate`` and to a cache-disabled engine
+    whether the cache hits, misses, or is disabled, while measurably
+    cutting prefill compute on shared-prefix traffic — with the compile
+    probes pinning the declared program set."""
+
+    def test_mixed_workload_parity_two_passes(self, model, prefix_engine):
+        """The 32-request acceptance workload, twice: pass 1 is all
+        cache misses, pass 2 re-serves identical prompts through cache
+        hits (including full-prompt matches that exercise the COW cap).
+        Every output of both passes byte-matches generate()."""
+        engine = prefix_engine
+        prompts, max_new, arrivals = _mixed_workload()
+        for _pass in (1, 2):
+            done = {}
+            pending = list(zip(prompts, max_new, arrivals))
+            step = 0
+            submitted = []
+            while pending or engine.has_unfinished():
+                while pending and pending[0][2] <= step:
+                    p, k, _ = pending.pop(0)
+                    submitted.append(engine.add_request(
+                        p, SamplingParams(max_new_tokens=k)
+                    ))
+                for out in engine.step():
+                    done[out.request_id] = out
+                step += 1
+                assert step < 500, "engine failed to drain"
+            assert len(done) == len(prompts)
+            for req, p, k in zip(submitted, prompts, max_new):
+                ref = _generate_oracle(model, p, k)
+                assert done[req.request_id].token_ids == ref, (
+                    _pass, req.request_id,
+                )
+        m = engine.metrics
+        # pass 2 actually reused cached prefixes (and diverged via COW
+        # where the one-token cap cut into a fully-matched prompt)
+        assert m.prefix_hit_tokens > 0
+        assert m.cow_copies >= 1
+        # compile probe: ONE decode program, at most one program per
+        # bucket per prefill family, one COW — zero traces beyond the
+        # declared set (counters bump only inside traced bodies)
+        assert m.decode_compiles == 1
+        assert m.prefill_compiles <= 3
+        assert m.prefill_ext_compiles <= 3
+        assert m.cow_compiles <= 1
+        # drained: every non-cached block returned to the free list
+        bm = engine.block_manager
+        assert bm.num_used == engine.prefix_cache.reclaimable_blocks()
+
+    def test_cache_disabled_engine_byte_matches_enabled(
+        self, model, small_engine, prefix_engine
+    ):
+        """Same prompts through the module's cache-disabled engine and
+        the cache+chunking engine: byte-identical greedy outputs."""
+        prompts = [[21, 22, 23, 24], [31, 32, 33], [41, 42, 43, 44, 45]]
+        params = SamplingParams(max_new_tokens=6)
+        plain = small_engine.generate(prompts, params)
+        cached = prefix_engine.generate(prompts, params)   # miss pass
+        cached2 = prefix_engine.generate(prompts, params)  # hit pass
+        for a, b, c in zip(plain, cached, cached2):
+            assert a.token_ids == b.token_ids == c.token_ids
+
+    def test_shared_system_prompt_cuts_prefill_compute(
+        self, model, prefix_engine
+    ):
+        """Perf evidence (counter-based): with a 16-token shared system
+        prompt, prefill tokens COMPUTED drop by exactly the shared
+        fraction once the prefix is cached."""
+        engine = prefix_engine
+        sys_prefix = list(range(60, 76))          # 16 tokens, 4 blocks
+        warm = sys_prefix + [90, 91, 92, 93]
+        params = SamplingParams(max_new_tokens=4)
+        engine.generate([warm], params)           # publishes the prefix
+        m = engine.metrics
+        tails = [[100 + 4 * i + j for j in range(4)] for i in range(6)]
+        prompts = [sys_prefix + t for t in tails]
+        computed0 = m.prefill_tokens
+        hit0 = m.prefix_hit_tokens
+        outs = engine.generate(prompts, params)
+        total = sum(len(p) for p in prompts)
+        shared = 16 * len(prompts)
+        # every request reused the full shared prefix: computed tokens
+        # dropped by >= the shared-prefix fraction (here: exactly)
+        assert m.prefix_hit_tokens - hit0 == shared
+        assert m.prefill_tokens - computed0 == total - shared
+        # and the reuse is bit-transparent
+        for out, p in zip(outs[:2], prompts[:2]):
+            assert out.token_ids == _generate_oracle(model, p, 4)
+
+    def test_chunked_prefill_interleaves_decode(
+        self, model, prefix_engine
+    ):
+        """A 13-token prompt (chunks of 8: two launches) must NOT stall
+        the decode batch: the short request keeps producing a token
+        every step while the long prompt prefills chunk by chunk."""
+        engine = prefix_engine
+        rng = np.random.default_rng(7)
+        short_p = [int(t) for t in rng.integers(1, 128, 4)]
+        long_p = [int(t) for t in rng.integers(1, 128, 13)]
+        chunks0 = engine.metrics.prefill_chunks
+        short = engine.add_request(
+            short_p, SamplingParams(max_new_tokens=12)
+        )
+        engine.step()   # short admitted + prefilled + first decode
+        n_before = len(short.output_token_ids)
+        long = engine.add_request(long_p, SamplingParams(max_new_tokens=3))
+        engine.step()   # long chunk 1/2; short decodes
+        assert long.state is serving.RequestState.PREFILLING
+        assert long.output_token_ids == []
+        assert len(short.output_token_ids) == n_before + 1
+        engine.step()   # long chunk 2/2 (final) + decode
+        assert long.state in (
+            serving.RequestState.RUNNING, serving.RequestState.FINISHED,
+        )
+        assert len(long.output_token_ids) >= 1
+        assert len(short.output_token_ids) == n_before + 2
+        assert engine.metrics.prefill_chunks == chunks0 + 2
+        out = {o.request_id: o for o in []}
+        done = _drain(engine)
+        out.update(done)
+        assert out[short.request_id].token_ids == _generate_oracle(
+            model, short_p, 12
+        )
+        assert out[long.request_id].token_ids == _generate_oracle(
+            model, long_p, 3
+        )
+
+    def test_cow_divergence_never_mutates_shared_block(
+        self, model, prefix_engine
+    ):
+        """Re-serving a prompt of exactly full blocks forks all but the
+        last matched block and COPY-ON-WRITES that one (the one-token
+        cap makes this request re-write its final slot). The shared
+        original's bits must be untouched, and both runs byte-match."""
+        engine = prefix_engine
+        prompt = [70, 71, 72, 73, 74, 75, 76, 77]    # 2 full blocks
+        params = SamplingParams(max_new_tokens=5)
+        first = engine.generate([prompt], params)[0]
+        match = engine.prefix_cache.lookup(prompt, limit=len(prompt))
+        assert match is not None and match.num_shared == 2
+        b0, b1 = match.shared_blocks
+        snap = [
+            (np.asarray(engine.pool.k[li][:, b1]).copy(),
+             np.asarray(engine.pool.v[li][:, b1]).copy())
+            for li in range(engine.adapter.num_layers)
+        ]
+        cow0 = engine.metrics.cow_copies
+        second = engine.generate([prompt], params)[0]
+        assert engine.metrics.cow_copies == cow0 + 1
+        assert second.token_ids == first.token_ids
+        assert first.token_ids == _generate_oracle(model, prompt, 5)
+        for li, (ks, vs) in enumerate(snap):
+            assert np.array_equal(
+                np.asarray(engine.pool.k[li][:, b1]), ks
+            ), f"layer {li}: shared K block mutated by COW divergence"
+            assert np.array_equal(
+                np.asarray(engine.pool.v[li][:, b1]), vs
+            ), f"layer {li}: shared V block mutated by COW divergence"
+
+    def test_reclaimable_cached_blocks_are_not_pressure(
+        self, model, prefix_engine
+    ):
+        """Retained cache blocks count as reclaimable capacity: they
+        must not trip the shedding threshold, and health() reports the
+        active/reclaimable split."""
+        engine = prefix_engine
+        engine.generate([[80, 81, 82, 83, 84]],
+                        SamplingParams(max_new_tokens=2))
+        bm = engine.block_manager
+        assert bm.num_used > 0          # retained cache blocks
+        h = engine.health()
+        assert h["kv_reclaimable_blocks"] == bm.num_used
+        assert h["kv_active_utilization"] == 0.0
+        assert h["kv_utilization"] > 0.0
+        assert h["prefix_cache_blocks"] == len(engine.prefix_cache)
+        engine.config.kv_shed_threshold = 0.01
+        try:
+            # raw utilization is over threshold, active is 0: admission
+            # must neither shed nor report overloaded
+            ok = engine.add_request([1, 2],
+                                    SamplingParams(max_new_tokens=2))
+            assert "overloaded" not in engine.health()["flags"]
+            out = _drain(engine)
+            assert out[ok.request_id].finish_reason == "length"
+        finally:
+            engine.config.kv_shed_threshold = None
+
+    def test_prefill_analysis_gate(self, prefix_engine):
+        """check_decode's counterpart for the new program family: the
+        continuation prefill and COW step carry zero host-sync/retrace
+        findings, and the trace-only check never moves the compile
+        probes."""
+        m = prefix_engine.metrics
+        before = (m.prefill_ext_compiles, m.cow_compiles)
+        report = prefix_engine.check_prefill("error")
+        assert not report.by_rule("host-sync")
+        assert not report.by_rule("retrace-hazard")
+        assert (m.prefill_ext_compiles, m.cow_compiles) == before
+        with pytest.raises(ValueError, match="mode"):
+            prefix_engine.check_prefill("loud")
+
+    def test_config_validation_and_adapter_gate(self, model):
+        with pytest.raises(ValueError, match="prefill_chunk_tokens"):
+            EngineConfig(max_model_len=32, prefill_chunk_tokens=0)
+        with pytest.raises(ValueError, match="largest prefill bucket"):
+            EngineConfig(max_model_len=32, prefill_chunk_tokens=64)
+        with pytest.raises(ValueError, match="prefix_cache_blocks"):
+            EngineConfig(enable_prefix_cache=True, prefix_cache_blocks=0)
+        with pytest.raises(ValueError, match="max_prefill_chunks"):
+            EngineConfig(max_prefill_chunks_per_step=0)
+
+        class MinimalAdapter:
+            """Duck-typed adapter WITHOUT prefill_ext: fine for plain
+            serving, rejected when the features need continuations."""
+            import jax.numpy as _jnp
+
+            num_layers, num_kv_heads, head_dim, vocab_size = 1, 1, 4, 8
+            weights = {"embed": _jnp.zeros((8, 4), "float32")}
+
+            def prefill(self, *a):
+                raise NotImplementedError
+
+            def decode(self, *a):
+                raise NotImplementedError
+
+        Engine(MinimalAdapter(), EngineConfig(
+            max_batch_slots=1, max_model_len=16, page_size=4,
+        ))  # plain config builds fine
+        with pytest.raises(TypeError, match="prefill_ext"):
+            Engine(MinimalAdapter(), EngineConfig(
+                max_batch_slots=1, max_model_len=16, page_size=4,
+                enable_prefix_cache=True,
+            ))
+
+
+class TestPrefixCacheUnit:
+    """Host-only BlockManager + PrefixCache invariants: refcount safety
+    under sharing, chain-keyed matching, LRU eviction returning blocks
+    to the free list."""
+
+    def test_register_retains_and_eviction_releases(self):
+        from paddle_tpu.serving import BlockManager, PrefixCache
+
+        bm = BlockManager(8, 4)
+        pc = PrefixCache(bm, capacity_blocks=2)
+        blocks = bm.allocate(3)
+        assert bm.high_water == 3
+        pc.register(list(range(12)), blocks, 12)
+        # budget 2: the tail entry was evicted leaf-first immediately
+        assert len(pc) == 2
+        bm.free(blocks)   # the owning request releases
+        # evicted tail block went back to the free list; the two cached
+        # blocks are retained by the cache's own reference
+        assert bm.num_used == 2
+        assert pc.reclaimable_blocks() == 2
+        assert pc.reclaim(2) == 2
+        assert bm.num_used == 0 and bm.num_free == 8
+        # refcount discipline survived the whole dance
+        with pytest.raises(RuntimeError, match="double free"):
+            bm.free([blocks[0]])
+        with pytest.raises(RuntimeError, match="fork of free"):
+            bm.fork([blocks[0]])
+
+    def test_lookup_chain_cap_and_cow(self):
+        from paddle_tpu.serving import BlockManager, PrefixCache
+
+        bm = BlockManager(8, 4)
+        pc = PrefixCache(bm, capacity_blocks=8)
+        blocks = bm.allocate(2)
+        prompt = list(range(8))
+        pc.register(prompt, blocks, 8)
+        # full-width match, block-aligned cap: both blocks forkable
+        m = pc.lookup(prompt, limit=8)
+        assert m.cache_len == 8
+        assert m.shared_blocks == blocks and m.cow_src is None
+        # the one-token-to-prefill cap cuts into the last block: only
+        # the first is forked, the second becomes the COW source
+        m = pc.lookup(prompt, limit=7)
+        assert m.cache_len == 7
+        assert m.shared_blocks == blocks[:1]
+        assert m.cow_src == blocks[1]
+        # divergent second block: chain stops after one block
+        m = pc.lookup(prompt[:4] + [99, 98, 97, 96], limit=7)
+        assert m.cache_len == 4 and m.shared_blocks == blocks[:1]
+        # nothing shared / prompt shorter than a block: miss
+        assert pc.lookup(list(range(100, 108)), limit=7) is None
+        assert pc.lookup(prompt[:3], limit=2) is None
+
+    def test_reclaim_skips_blocks_live_requests_hold(self):
+        from paddle_tpu.serving import BlockManager, PrefixCache
+
+        bm = BlockManager(8, 4)
+        pc = PrefixCache(bm, capacity_blocks=8)
+        blocks = bm.allocate(2)
+        pc.register(list(range(8)), blocks, 8)
+        # a second request forks the blocks (still reading them)
+        bm.fork(blocks)
+        bm.free(blocks)  # first owner gone; cache ref + reader remain
+        assert pc.reclaimable_blocks() == 0
+        assert pc.reclaim(2) == 0        # nothing reclaimable
+        bm.free(blocks)  # reader done
+        assert pc.reclaimable_blocks() == 2
+        # protect the chain ROOT: the unprotected leaf frees, then the
+        # root survives as the new (protected) leaf
+        assert pc.reclaim(5, protect={blocks[0]}) == 1
+        assert bm.ref_count(blocks[0]) == 1
+        assert bm.ref_count(blocks[1]) == 0
+
+
+class TestKVPoolRebind:
+    def test_rebind_validates_layout(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.serving import KVPool
+
+        pool = KVPool(2, 2, 4, 4, 8)
+        pool.rebind(pool.k, pool.v)   # identity rebind is fine
+        with pytest.raises(ValueError, match="expected 2 k/v layers"):
+            pool.rebind(pool.k[:1], pool.v[:1])
+        bad = tuple(jnp.zeros((2, 4, 4, 4), "float32") for _ in range(2))
+        with pytest.raises(ValueError) as ei:
+            pool.rebind(bad, pool.v)
+        # both shapes named in the error
+        assert "(2, 4, 4, 4)" in str(ei.value)
+        assert "(2, 4, 4, 8)" in str(ei.value)
+        wrong_dtype = tuple(
+            jnp.zeros((2, 4, 4, 8), "bfloat16") for _ in range(2)
+        )
+        with pytest.raises(ValueError, match="dtype"):
+            pool.rebind(wrong_dtype, pool.v)
