@@ -25,11 +25,14 @@ uses the Pallas paged kernel on TPU and the XLA reference path elsewhere.
 Any object exposing the same five attributes and two methods (see
 ``required_attrs``) can serve — the engine duck-types, it never imports a
 model class. An optional ``dtype`` attribute names the KV-pool dtype;
-without it the engine reads ``weights["embed"].dtype``. An optional
-third entry point, ``prefill_ext(w, kp, vp, ids, length, cache_len,
-block_table)``, continues a prefill whose first ``cache_len`` tokens
-are already in the pages — required only when the engine enables
-prefix caching or chunked prefill.
+without it the engine reads ``weights["embed"].dtype``. Two optional
+entry points extend the surface: ``prefill_ext(w, kp, vp, ids, length,
+cache_len, block_table)`` continues a prefill whose first ``cache_len``
+tokens are already in the pages — required only when the engine enables
+prefix caching or chunked prefill — and ``verify(w, kp, vp, tokens,
+positions, draft_lens, block_tables, active)`` scores a K+1-token draft
+window for every slot in one launch — required only when the engine
+enables speculative decoding (``EngineConfig(speculate_tokens=)``).
 """
 from __future__ import annotations
 
@@ -93,6 +96,26 @@ def _write_chunk_pages(pages, kv, block_table, length, cache_len):
     return pages.at[:, phys, slot].set(
         jnp.swapaxes(kv, 0, 1).astype(pages.dtype)
     )
+
+
+def _write_window_pages(pages, kv, phys, slot):
+    """Batched form of ``_write_chunk_pages``: scatter a [slots, S,
+    kv_heads, d] token window into the pages at precomputed physical
+    coordinates ``phys``/``slot`` [slots, S] (invalid positions carry
+    ``phys == num_blocks`` so the scatter drops them — the same
+    out-of-bounds routing every other page write uses)."""
+    vals = jnp.moveaxis(kv, 2, 0).astype(pages.dtype)  # [kv, slots, S, d]
+    return pages.at[:, phys, slot].set(vals)
+
+
+def _gather_context_batch(pages, block_tables):
+    """``_gather_context`` for every slot at once: ``block_tables``
+    [slots, P] gathers to ``[slots, P*bs, kv_heads, d]`` — slot s's
+    logical KV timeline, position p at row p. Same layout, same
+    reduction order as the single-sequence gather, just batched."""
+    g = pages[:, block_tables]             # [kv, slots, P, bs, d]
+    g = jnp.moveaxis(g, 0, 3)              # [slots, P, bs, kv, d]
+    return g.reshape(g.shape[0], -1, g.shape[3], g.shape[4])
 
 
 def _gather_context(pages, block_table):
@@ -284,6 +307,82 @@ class LlamaServingAdapter:
                 q[:, 0], kp[li], vp[li], block_tables, lengths
             )                                          # [slots, heads, d]
             x = x + attn.reshape(b, -1) @ wl["wo"]
+            x = self._mlp(wl, x)
+        x = _rms_norm(x, w["norm"], epsilon=self.eps)
+        return self._logits(w, x), tuple(kp), tuple(vp)
+
+    def verify(self, w, kp, vp, tokens, positions, draft_lens,
+               block_tables, active):
+        """Speculative verification: score a K+1-token window for every
+        slot in ONE launch. ``tokens`` [slots, S] (S = K+1) holds each
+        slot's pending ``last_token`` at column 0 and its drafted
+        continuation after it; window token j sits at GLOBAL position
+        ``positions[slot] + j``. ``draft_lens`` [slots] counts valid
+        draft tokens, so columns 0..draft_lens are real and columns
+        with index > ``draft_lens`` are padding: their page writes are
+        routed out of bounds and their logits are garbage the engine
+        never reads — same for inactive slots.
+        Returns (logits [slots, S, vocab], kp, vp) where row j scores
+        the token FOLLOWING position ``positions[slot] + j``.
+
+        Bit-parity contract: attention runs in the exact ``_sdpa``
+        masked form over the gathered page timeline that ``prefill_ext``
+        (and ``generate``'s cached branch) uses — the form PR 8 proved
+        byte-identical to the one-shot program — and each slot's rows
+        reduce independently of the batch dimension, so row 0's logits
+        (and the K/V written for accepted positions) are byte-identical
+        to what the plain decode step would have produced. A rejected
+        position's write is DEAD: the engine advances ``num_cached``
+        only by the accepted count, the causal ``keep`` mask of every
+        later launch stops at the query's own position, and a later
+        write at the same position overwrites it."""
+        b, s = tokens.shape
+        n_blocks = kp[0].shape[1]
+        bs_pg = kp[0].shape[2]
+        capacity = block_tables.shape[1] * bs_pg
+        offs = jnp.arange(s, dtype=jnp.int32)[None]        # [1, S]
+        pos = positions[:, None] + offs                    # [slots, S]
+        valid = (
+            active[:, None]
+            & (offs <= draft_lens[:, None])
+            & (pos < capacity)
+        )
+        phys = jnp.where(
+            valid,
+            jnp.take_along_axis(
+                block_tables,
+                jnp.minimum(pos // bs_pg, block_tables.shape[1] - 1),
+                axis=1,
+            ),
+            n_blocks,                                      # scatter drop
+        )
+        slot = pos % bs_pg
+        # keep[q, c] per slot: context position c visible to window
+        # token q — causal over the global timeline, so a valid query
+        # only ever sees history plus THIS launch's earlier writes
+        # (stale rejected-draft rows sit beyond it and mask to exact
+        # zeros after the softmax)
+        keep = (
+            jnp.arange(capacity, dtype=jnp.int32)[None, None, :]
+            <= pos[:, :, None]
+        )[:, None]                                         # [b, 1, S, C]
+        x = w["embed"][tokens]                             # [b, S, hid]
+        kp, vp = list(kp), list(vp)
+        for li in range(self.num_layers):
+            wl = w["layers"][li]
+            h = _rms_norm(x, wl["ln1"], epsilon=self.eps)
+            q, k, v = self._qkv(wl, h, b, s)
+            q, k = _rope_qk(q, k, pos, base=self.rope_theta)
+            kp[li] = _write_window_pages(kp[li], k, phys, slot)
+            vp[li] = _write_window_pages(vp[li], v, phys, slot)
+            kc = _gather_context_batch(kp[li], block_tables)
+            vc = _gather_context_batch(vp[li], block_tables)
+            if self.num_kv_heads != self.num_heads:
+                rep = self.num_heads // self.num_kv_heads
+                kc = jnp.repeat(kc, rep, axis=2)
+                vc = jnp.repeat(vc, rep, axis=2)
+            attn = _sdpa(q, kc, vc, keep, is_causal=False)
+            x = x + attn.reshape(b, s, -1) @ wl["wo"]
             x = self._mlp(wl, x)
         x = _rms_norm(x, w["norm"], epsilon=self.eps)
         return self._logits(w, x), tuple(kp), tuple(vp)

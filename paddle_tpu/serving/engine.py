@@ -31,6 +31,15 @@ prefill is enabled (both bit-transparent to greedy outputs):
     divergence step when a cache match's one-token-to-prefill cap cuts
     into the last shared block. One compile total.
 
+And one more with speculative decoding (``speculate_tokens=K``):
+
+  * VERIFY: score every greedy slot's K+1-token draft window (pending
+    token + prompt-lookup drafts) in one launch and return per-position
+    argmax targets; the engine accepts the longest target-matching
+    draft prefix and emits accepted+1 tokens — byte-identical to plain
+    greedy decode in up to (K+1)x fewer launches. One compile total;
+    sampled slots keep the plain decode path.
+
 Scheduling policy (host-side, cheap):
   * admission control — FCFS from the waiting queue into free slots,
     gated on KV blocks for the whole prompt plus one decode step;
@@ -79,6 +88,7 @@ from ..resilience import faults
 from .adapter import build_adapter
 from .kv_cache import BlockManager, KVPool
 from .metrics import EngineMetrics
+from . import speculation
 from .request import (
     Request,
     RequestOutput,
@@ -131,7 +141,8 @@ class EngineConfig:
                  seed=0, kv_shed_threshold=None, analysis_check=None,
                  compile_cache=None, enable_prefix_cache=False,
                  prefix_cache_blocks=None, prefill_chunk_tokens=None,
-                 max_prefill_chunks_per_step=1):
+                 max_prefill_chunks_per_step=1, speculate_tokens=None,
+                 speculate_ngram=3):
         if max_batch_slots < 1:
             raise ValueError("max_batch_slots must be >= 1")
         if page_size < 1 or max_model_len < 2:
@@ -231,6 +242,33 @@ class EngineConfig:
                 f"{max_prefill_chunks_per_step}"
             )
         self.max_prefill_chunks_per_step = int(max_prefill_chunks_per_step)
+        # speculative decoding: None disables (one decode launch = one
+        # token, today's behavior); an int K routes greedy slots
+        # through the VERIFY program — up to K prompt-lookup draft
+        # tokens scored alongside the pending token in one launch, the
+        # longest target-matching prefix accepted. Greedy outputs are
+        # byte-identical either way; sampled slots keep the plain
+        # decode path (and its key-stream discipline).
+        if speculate_tokens is not None:
+            if speculate_tokens < 1:
+                raise ValueError(
+                    f"speculate_tokens must be >= 1 or None (disabled), "
+                    f"got {speculate_tokens}"
+                )
+            if speculate_tokens >= self.max_model_len:
+                raise ValueError(
+                    f"speculate_tokens ({speculate_tokens}) must be "
+                    f"smaller than max_model_len ({self.max_model_len})"
+                )
+        self.speculate_tokens = (
+            None if speculate_tokens is None else int(speculate_tokens)
+        )
+        if speculate_ngram < 1:
+            raise ValueError(
+                f"speculate_ngram must be >= 1, got {speculate_ngram}"
+            )
+        # longest trailing n-gram the prompt-lookup drafter matches on
+        self.speculate_ngram = int(speculate_ngram)
         self.seed = int(seed)
 
 
@@ -389,10 +427,27 @@ class Engine:
             vp = tuple(p.at[:, dst].set(p[:, src]) for p in vp)
             return kp, vp
 
+        # speculative verification: score every slot's K+1-token draft
+        # window in one launch and return the per-position greedy
+        # argmax — the targets the host-side accept loop compares the
+        # drafts against. Greedy-only by design (sampled slots keep the
+        # plain decode path), so there is no sampling variant and no
+        # key operand: ONE program per engine, ever.
+        def verify_fn(w, kp, vp, tokens, positions, draft_lens,
+                      block_tables, active):
+            metrics.verify_compiles += 1    # traced-body compile probe
+            jit_events.mark_traced()        # global compile/retrace log
+            logits, kp, vp = adapter.verify(
+                w, kp, vp, tokens, positions, draft_lens, block_tables,
+                active,
+            )
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), kp, vp
+
         self._prefill_fn = prefill_fn   # unjitted: analysis traces these
         self._decode_fn = decode_fn
         self._prefill_ext_fn = prefill_ext_fn
         self._cow_fn = cow_fn
+        self._verify_fn = verify_fn
         self._prefill_jit = jax.jit(
             prefill_fn, donate_argnums=donate, static_argnums=(11,)
         )
@@ -406,15 +461,35 @@ class Engine:
             cow_fn,
             donate_argnums=(0, 1) if self._pool_donated else (),
         )
+        self._verify_jit = jax.jit(verify_fn, donate_argnums=donate)
         cfg = self.config
         self._chunking = cfg.prefill_chunk_tokens is not None
         self._use_ext = self._chunking or cfg.enable_prefix_cache
+        self._speculating = cfg.speculate_tokens is not None
+        # optional-entry-point gates, at BUILD time: one clear error
+        # naming the missing adapter method and the config flag that
+        # needs it, instead of a deep trace-time AttributeError on the
+        # first launch that would have used it
         if self._use_ext and not hasattr(adapter, "prefill_ext"):
+            flags = [
+                f for f, on in (
+                    ("enable_prefix_cache=True", cfg.enable_prefix_cache),
+                    (f"prefill_chunk_tokens={cfg.prefill_chunk_tokens}",
+                     self._chunking),
+                ) if on
+            ]
             raise TypeError(
                 f"{type(adapter).__name__} has no prefill_ext entry "
-                "point: prefix caching / chunked prefill need an "
+                f"point, but EngineConfig({', '.join(flags)}) needs an "
                 "adapter that can continue a prefill at a nonzero "
                 "cache length"
+            )
+        if self._speculating and not hasattr(adapter, "verify"):
+            raise TypeError(
+                f"{type(adapter).__name__} has no verify entry point, "
+                f"but EngineConfig(speculate_tokens="
+                f"{cfg.speculate_tokens}) needs an adapter that can "
+                "score a K+1-token draft window in one launch"
             )
         # persistent compile cache: with a cache configured, every
         # launch goes through an AOT-compiled executable held in
@@ -434,6 +509,8 @@ class Engine:
             self.check_decode(self.config.analysis_check)
             if self._use_ext:
                 self.check_prefill(self.config.analysis_check)
+            if self._speculating:
+                self.check_verify(self.config.analysis_check)
 
     # -- persistent compile cache (paddle_tpu.compilecache) ------------------
     def _abstract_args(self, kind, bucket=None):
@@ -469,6 +546,14 @@ class Engine:
             )
         if kind == "cow":
             return (kp, vp, sds((), jnp.int32), sds((), jnp.int32))
+        if kind == "verify":
+            return (
+                w, kp, vp,
+                sds((n, cfg.speculate_tokens + 1), jnp.int32),
+                sds((n,), jnp.int32), sds((n,), jnp.int32),
+                sds((n, cfg.pages_per_seq), jnp.int32),
+                sds((n,), jnp.bool_),
+            )
         return (
             w, kp, vp,
             sds((n,), jnp.int32), sds((n,), jnp.int32),
@@ -494,6 +579,11 @@ class Engine:
 
         aargs = self._abstract_args(kind, bucket)
         name = f"serving.{kind}"
+        # no explicit spec-K component: the verify window's K is
+        # already pinned by the abstract tokens shape (n, K+1) inside
+        # signature_str, and adding a constant to the other kinds'
+        # signatures would invalidate every pre-existing on-disk
+        # program for nothing
         sig = (
             f"{kind}:bucket={bucket}:any_sample={any_sample}:"
             f"code={self._adapter_code_fp}:"
@@ -507,15 +597,22 @@ class Engine:
                 "prefill_ext": self._prefill_ext_jit,
                 "decode": self._decode_jit,
                 "cow": self._cow_jit,
+                "verify": self._verify_jit,
             }[kind]
             if kind in ("prefill", "prefill_ext"):
-                ev_sig = f"{self.engine_id}:bucket={bucket}"
+                ev_sig = (f"{self.engine_id}:bucket={bucket}"
+                          f":any_sample={any_sample}")
             elif kind == "decode":
                 ev_sig = f"{self.engine_id}:any_sample={any_sample}"
+            elif kind == "verify":
+                ev_sig = (f"{self.engine_id}"
+                          f":k={self.config.speculate_tokens}")
             else:
                 ev_sig = self.engine_id
             with jit_events.watch(name, kind="serving", signature=ev_sig):
-                if kind == "cow":
+                if kind in ("cow", "verify"):
+                    # no static sampling variant: cow copies blocks,
+                    # verify is greedy-only by contract
                     exe = jitted.lower(*aargs).compile()
                 else:
                     exe = jitted.lower(*aargs, any_sample).compile()
@@ -572,6 +669,8 @@ class Engine:
             or "?",
             code_fingerprint(getattr(self.adapter, "prefill_ext", None))
             or "?",
+            code_fingerprint(getattr(self.adapter, "verify", None))
+            or "?",
         ))
         svc = (
             signature_str((
@@ -583,6 +682,7 @@ class Engine:
             + f"|buckets={cfg.prefill_buckets}"
             + f"|chunk={cfg.prefill_chunk_tokens}"
             + f"|pfx={int(cfg.enable_prefix_cache)}"
+            + f"|spec={cfg.speculate_tokens}"
             + f"|code={self._adapter_code_fp}"
         )
         self._service_key = hashlib.sha256(svc.encode()).hexdigest()[:16]
@@ -606,6 +706,8 @@ class Engine:
                     )
                 if cfg.enable_prefix_cache:
                     self._ensure_program("cow")
+            if self._speculating:
+                self._ensure_program("verify")
             for e in replay:
                 kind, bucket = e.get("kind"), e.get("bucket")
                 if kind == "prefill" and bucket in cfg.prefill_buckets:
@@ -625,6 +727,8 @@ class Engine:
                     )
                 elif kind == "cow" and cfg.enable_prefix_cache:
                     self._ensure_program("cow")
+                elif kind == "verify" and self._speculating:
+                    self._ensure_program("verify")
         finally:
             self._warming = False
         self._save_manifest()  # one fsync'd rewrite for the whole set
@@ -779,6 +883,61 @@ class Engine:
             warnings.warn(msg, stacklevel=2)
         return report
 
+    def check_verify(self, mode="error"):
+        """``check_decode``'s counterpart for the speculative VERIFY
+        program: statically analyze the draft-window scoring step and
+        assert zero host-sync and retrace findings — a verify launch
+        replaces the decode launch on the latency-critical greedy path,
+        so it is held to the same single-compile invariant. Trace-only;
+        compile probes are restored after. Returns the analysis
+        Report."""
+        from .. import analysis
+
+        if mode not in ("warn", "error"):
+            raise ValueError(
+                f'check_verify mode must be "warn" or "error", got '
+                f"{mode!r}"
+            )
+        cfg = self.config
+        if cfg.speculate_tokens is None:
+            raise RuntimeError(
+                "check_verify needs EngineConfig(speculate_tokens=): "
+                "this engine has speculation disabled"
+            )
+        n, k = cfg.max_batch_slots, cfg.speculate_tokens
+        m = self.metrics
+        saved = (m.prefill_compiles, m.decode_compiles,
+                 m.verify_compiles)
+        try:
+            report = analysis.check(
+                self._verify_fn,
+                self.adapter.weights, self.pool.k, self.pool.v,
+                np.zeros((n, k + 1), np.int32), np.zeros(n, np.int32),
+                np.zeros(n, np.int32),
+                np.zeros((n, cfg.pages_per_seq), np.int32),
+                np.zeros(n, bool),
+                donate_argnums=(1, 2) if self._pool_donated else (),
+                mode=mode,
+            )
+        finally:
+            (m.prefill_compiles, m.decode_compiles,
+             m.verify_compiles) = saved
+        blocking = report.by_rule("host-sync") + report.by_rule(
+            "retrace-hazard"
+        )
+        if blocking:
+            msg = (
+                "serving verify step failed static analysis (the "
+                "speculative-decode latency invariant):\n"
+                + "\n".join(f.render() for f in blocking)
+            )
+            if mode == "error":
+                raise analysis.AnalysisError(msg, report)
+            import warnings
+
+            warnings.warn(msg, stacklevel=2)
+        return report
+
     def _next_key(self):
         self._key_counter += 1
         return jax.random.fold_in(self._base_key, self._key_counter)
@@ -837,16 +996,36 @@ class Engine:
     def _active_pressure(self):
         """``(reclaimable_blocks, active_utilization)`` — the pressure
         split every consumer (shedding, health, metrics gauges) must
-        agree on: cached prefix blocks nobody runs against are
-        RECLAIMABLE capacity, not pressure, so a pool kept warm by the
-        prefix cache neither sheds admissions nor reads as
+        agree on: cached prefix blocks nobody runs against and idle
+        speculative draft headroom are RECLAIMABLE capacity, not
+        pressure, so a pool kept warm by the prefix cache (or padded
+        by draft headroom) neither sheds admissions nor reads as
         overloaded."""
         bm = self.block_manager
         reclaimable = (
             self.prefix_cache.reclaimable_blocks()
             if self.prefix_cache is not None else 0
         )
+        if self._speculating:
+            reclaimable += sum(
+                self._spec_headroom(r) for r in self.slots
+            )
         return reclaimable, (bm.num_used - reclaimable) / bm.num_blocks
+
+    def _spec_headroom(self, req):
+        """Idle draft-headroom blocks a greedy RUNNING slot holds
+        beyond its required ``num_cached + 1`` coverage (0 for every
+        other slot) — THE shared definition behind pressure accounting
+        (:meth:`_active_pressure`) and reclaim
+        (:meth:`_reclaim_spec_headroom`); they must agree or admission
+        would see capacity reclaim cannot actually deliver."""
+        if (req is None or req.state is not RequestState.RUNNING
+                or req.sampling_params.do_sample):
+            return 0
+        return max(
+            len(req.block_ids)
+            - self.block_manager.blocks_needed(req.num_cached + 1), 0,
+        )
 
     def resume(self, req):
         """Re-enqueue a request whose KV state was lost OUTSIDE the
@@ -1037,6 +1216,16 @@ class Engine:
                 len(self.prefix_cache)
                 if self.prefix_cache is not None else 0
             ),
+            # cached chain keys (wire form): a fleet router matches a
+            # request's prompt digests against these to find the
+            # replica already holding its prefix (hit-aware routing)
+            "prefix_cache_digests": (
+                self.prefix_cache.chain_digests()
+                if self.prefix_cache is not None else []
+            ),
+            # speculation economics: accepted / proposed draft tokens
+            # (None until the first proposal)
+            "spec_accept_rate": m.spec_accept_rate,
             "requests_errored": m.requests_errored,
             "requests_timeout": m.requests_timeout,
             "requests_shed": m.requests_shed,
@@ -1104,6 +1293,11 @@ class Engine:
                         n_alloc - bm.num_free, protect=protect
                     )
                 if not bm.can_allocate(n_alloc):
+                    # idle draft headroom is reclaimable capacity too:
+                    # an admission must never be refused while
+                    # speculation holds unused blocks
+                    self._reclaim_spec_headroom(n_alloc - bm.num_free)
+                if not bm.can_allocate(n_alloc):
                     break
             self.waiting.popleft()
             if self.prefix_cache is not None:
@@ -1166,9 +1360,13 @@ class Engine:
             "serving.prefill", request_id=req.request_id, bucket=bucket,
         ), self._watch("serving.prefill"), jit_events.watch(
             # engine id in the signature: a SECOND engine compiling its
-            # own programs is a fresh compile, not a retrace alarm
+            # own programs is a fresh compile, not a retrace alarm —
+            # and any_sample is a static compile key (same as decode's
+            # signature), so the first sampled request on a warm bucket
+            # is a fresh variant, not a retrace
             "serving.prefill", kind="serving",
-            signature=f"{self.engine_id}:bucket={bucket}",
+            signature=(f"{self.engine_id}:bucket={bucket}"
+                       f":any_sample={bool(p.do_sample)}"),
         ):
             try:
                 args = (
@@ -1299,7 +1497,8 @@ class Engine:
             bucket=bucket, cache_len=cache_len,
         ), self._watch("serving.prefill"), jit_events.watch(
             "serving.prefill_ext", kind="serving",
-            signature=f"{self.engine_id}:bucket={bucket}",
+            signature=(f"{self.engine_id}:bucket={bucket}"
+                       f":any_sample={any_sample}"),
         ):
             try:
                 args = (
@@ -1377,6 +1576,8 @@ class Engine:
                 if (self.prefix_cache is not None
                         and self.prefix_cache.reclaim(1)):
                     continue  # cached block freed: retry the allocate
+                if self._reclaim_spec_headroom(1):
+                    continue  # idle draft headroom freed: retry
                 victims = [
                     r for r in self.slots
                     if r is not None and r is not req
@@ -1388,6 +1589,37 @@ class Engine:
                         "max_model_len"
                     )
                 self._preempt(max(victims, key=lambda r: r.admit_seq))
+        if self._speculating:
+            # opportunistic draft headroom: a greedy slot's verify
+            # launch writes up to K positions past the required one,
+            # so grab blocks for them while the pool has slack — but
+            # NEVER preempt or reclaim for it (the host clamps each
+            # slot's draft length to its owned-block slack instead, so
+            # speculation degrades to plain decode under pressure
+            # rather than adding to it)
+            cfg = self.config
+            k = cfg.speculate_tokens
+            for req in self.slots:
+                if (req is None or req.state is not RequestState.RUNNING
+                        or req.sampling_params.do_sample):
+                    continue
+                # a request that can only consume w more drafts before
+                # its stop condition must not hold headroom beyond
+                # them; clamped at the block-table width too — near the
+                # length cap the window is cut by _draft_budget instead
+                want = min(
+                    k,
+                    req.sampling_params.max_new_tokens
+                    - len(req.output_token_ids) - 1,
+                )
+                if want <= 0:
+                    continue
+                need = min(
+                    bm.blocks_needed(req.num_cached + 1 + want),
+                    cfg.pages_per_seq,
+                )
+                while len(req.block_ids) < need and bm.can_allocate(1):
+                    req.block_ids += bm.allocate(1)
 
     def _preempt(self, req):
         self._release(req)
@@ -1403,13 +1635,50 @@ class Engine:
     def _decode(self, finished):
         # one key per scheduler step, shared by isolation re-launches:
         # greedy rows never consume it, and sampled rows see the same
-        # uniforms whether or not a poison request was carved out
+        # uniforms whether or not a poison request was carved out.
+        # Drawn unconditionally (even when only the keyless verify
+        # program runs) so the key stream advances once per step
+        # regardless of the greedy/sampled split.
         key = self._next_key()
         idxs = [
             i for i, r in enumerate(self.slots)
             if r is not None and r.state is RequestState.RUNNING
         ]
-        self._decode_subset(idxs, key, finished)
+        if not self._speculating:
+            self._decode_subset(idxs, key, finished)
+            return
+        # speculation splits the batch by sampling mode: greedy slots
+        # go through the verify program (several tokens per launch),
+        # sampled slots keep the plain decode path — speculative
+        # acceptance is defined against the greedy argmax, and a
+        # sampled row's token depends on the warp + key stream, which
+        # the verify program deliberately does not carry
+        greedy = [
+            i for i in idxs
+            if not self.slots[i].sampling_params.do_sample
+        ]
+        sampled = [
+            i for i in idxs if self.slots[i].sampling_params.do_sample
+        ]
+        # drafts are proposed up front: a step where nothing was
+        # drafted (no repetition to exploit anywhere) runs the plain
+        # single-launch decode over the whole running set instead —
+        # bit-identical, and the decode program is cheaper than a
+        # draft-less K+1 verify window, so speculation can never be a
+        # strict slowdown on non-repetitive traffic
+        drafts = {
+            i: speculation.propose(
+                self._draft_history(self.slots[i]),
+                self._draft_budget(self.slots[i]),
+                max_ngram=self.config.speculate_ngram,
+            )
+            for i in greedy
+        }
+        if not any(drafts.values()):
+            self._decode_subset(idxs, key, finished)
+            return
+        self._verify_subset(greedy, finished, drafts)
+        self._decode_subset(sampled, key, finished)
 
     def _launch_decode(self, idxs, key):
         """Run the compiled decode step with only ``idxs`` active.
@@ -1473,14 +1742,18 @@ class Engine:
         self.metrics.decode_steps += 1
         return nxt
 
-    def _decode_subset(self, idxs, key, finished):
-        """Decode ``idxs`` with poison isolation: on failure, carve the
-        poison request out (by exception attribution or bisection) and
-        finish it with an error while the rest still decode this step."""
-        if not idxs:
-            return
+    def _isolate(self, idxs, finished, launch, recurse):
+        """Shared poison-isolation protocol for batched launches
+        (decode and verify): run ``launch(idxs)``; on failure, carve
+        the poison request out — by exception attribution
+        (``exc.request_id``) or active-mask bisection via
+        ``recurse(subset)`` — and finish it with an error while the
+        rest still run this step. Returns the launch result, or None
+        when containment consumed the failure. Cluster-level aborts
+        (CommTimeoutError) and donated-pool losses re-raise: they are
+        not containable."""
         try:
-            nxt = self._launch_decode(idxs, key)
+            return launch(idxs)
         except CommTimeoutError:
             raise  # cluster-level abort, not a poison request
         except Exception as e:
@@ -1491,17 +1764,27 @@ class Engine:
                 i for i in idxs if self.slots[i].request_id == rid
             ] if rid is not None else []
             if hit:
-                # attributed failure: finish the culprit, decode the rest
+                # attributed failure: finish the culprit, run the rest
                 self._poison(self.slots[hit[0]], e, finished)
-                self._decode_subset(
-                    [i for i in idxs if i != hit[0]], key, finished
-                )
+                recurse([i for i in idxs if i != hit[0]])
             elif len(idxs) == 1:
                 self._poison(self.slots[idxs[0]], e, finished)
             else:
                 mid = len(idxs) // 2
-                self._decode_subset(idxs[:mid], key, finished)
-                self._decode_subset(idxs[mid:], key, finished)
+                recurse(idxs[:mid])
+                recurse(idxs[mid:])
+            return None
+
+    def _decode_subset(self, idxs, key, finished):
+        """Decode ``idxs`` with poison isolation (see ``_isolate``)."""
+        if not idxs:
+            return
+        nxt = self._isolate(
+            idxs, finished,
+            lambda s: self._launch_decode(s, key),
+            lambda s: self._decode_subset(s, key, finished),
+        )
+        if nxt is None:
             return
         cfg = self.config
         for i in idxs:
@@ -1514,6 +1797,167 @@ class Engine:
             reason = req.check_stop(cfg.max_model_len)
             if reason:
                 self._finish(req, reason, finished)
+
+    def _reclaim_spec_headroom(self, need):
+        """Free up to ``need`` speculative draft-headroom blocks back
+        to the pool — tail blocks beyond a greedy RUNNING slot's
+        required ``num_cached + 1`` coverage. They hold at most dead
+        draft writes (never published, never shared), so freeing them
+        is always safe; the slot's next draft budget just shrinks.
+        This is what keeps the headroom grab genuinely opportunistic:
+        admission and mandatory block growth take it back BEFORE
+        shedding, preempting, or refusing a request. Returns the
+        number freed."""
+        if not self._speculating:
+            return 0
+        bm = self.block_manager
+        freed = 0
+        for req in self.slots:
+            if freed >= need:
+                break
+            extra = self._spec_headroom(req)
+            while extra > 0 and freed < need:
+                bm.free([req.block_ids.pop()])
+                extra -= 1
+                freed += 1
+        return freed
+
+    def _draft_history(self, req):
+        """The drafter's bounded history window (prompt + output
+        tail), assembled without copying the whole token history every
+        step — the per-step host cost must not grow with context
+        length."""
+        lb = speculation.DEFAULT_LOOKBACK
+        out = req.output_token_ids
+        if len(out) >= lb:
+            return out[-lb:]
+        return req.prompt_token_ids[-(lb - len(out)):] + out
+
+    def _draft_budget(self, req):
+        """How many draft tokens slot state allows this step: writes
+        must stay inside the request's OWNED blocks (headroom is
+        opportunistic — see _ensure_capacity) and inside the model
+        length, and the request can consume at most remaining-1 drafts
+        before a stop condition ends it (proposals past that are
+        guaranteed waste). 0 degrades the slot to plain-decode-
+        through-verify."""
+        cfg = self.config
+        ceiling = min(
+            len(req.block_ids) * cfg.page_size, cfg.max_model_len
+        )
+        remaining = (
+            req.sampling_params.max_new_tokens
+            - len(req.output_token_ids)
+        )
+        return max(min(cfg.speculate_tokens,
+                       ceiling - (req.num_cached + 1),
+                       remaining - 1), 0)
+
+    def _launch_verify(self, idxs, drafts):
+        """Run the compiled verify step with only ``idxs`` active:
+        score each slot's K+1 window (pending token + its entry in
+        ``drafts``, proposed once per step in :meth:`_decode`) in one
+        launch, return ``(tokens, draft_lens, targets)`` for the
+        host-side accept loop. Per-slot outputs are independent (same
+        property as _launch_decode), so the poison-isolation bisection
+        applies unchanged — re-launches reuse the same drafts."""
+        cfg = self.config
+        n, k = cfg.max_batch_slots, cfg.speculate_tokens
+        tokens = np.zeros((n, k + 1), np.int32)
+        positions = np.zeros(n, np.int32)
+        draft_lens = np.zeros(n, np.int32)
+        tables = np.zeros((n, cfg.pages_per_seq), np.int32)
+        active = np.zeros(n, bool)
+        for i in idxs:
+            req = self.slots[i]
+            tokens[i, 0] = req.last_token
+            positions[i] = req.num_cached
+            tables[i, : len(req.block_ids)] = req.block_ids
+            active[i] = True
+            draft = drafts.get(i, [])
+            draft_lens[i] = len(draft)
+            tokens[i, 1: 1 + len(draft)] = draft
+        faults.fire(
+            "serving.step", phase="verify",
+            request_ids=tuple(self.slots[i].request_id for i in idxs),
+        )
+        with span(
+            "serving.verify", active=len(idxs),
+            proposed=int(draft_lens.sum()),
+        ), self._watch("serving.verify"), jit_events.watch(
+            "serving.verify", kind="serving",
+            signature=f"{self.engine_id}:k={k}",
+        ):
+            try:
+                args = (
+                    self.adapter.weights, self.pool.k, self.pool.v,
+                    tokens, positions, draft_lens, tables, active,
+                )
+                if self._cc is not None:
+                    exe = self._ensure_program("verify")
+                    tgt, kp, vp = exe(*args)
+                else:
+                    tgt, kp, vp = self._verify_jit(*args)
+            except Exception as e:
+                # same donated-buffer hazard as decode (_launch_decode)
+                if self._pool_donated:
+                    e._kv_pool_unsafe = True
+                raise
+            tgt = np.asarray(tgt)
+        self.pool.rebind(kp, vp)
+        self.metrics.verify_steps += 1
+        return tokens, draft_lens, tgt
+
+    def _verify_subset(self, idxs, finished, drafts):
+        """Speculative decode for greedy slots ``idxs`` with the same
+        poison isolation as _decode_subset (see ``_isolate``). On
+        success each slot accepts the longest draft prefix matching
+        the target argmax and emits accepted+1 tokens — every appended
+        token is exactly what a plain decode step would have produced,
+        checked through the same per-token stop conditions."""
+        if not idxs:
+            return
+        res = self._isolate(
+            idxs, finished,
+            lambda s: self._launch_verify(s, drafts),
+            lambda s: self._verify_subset(s, finished, drafts),
+        )
+        if res is None:
+            return
+        tokens, draft_lens, tgt = res
+        cfg, m = self.config, self.metrics
+        for i in idxs:
+            req = self.slots[i]
+            dlen = int(draft_lens[i])
+            a = speculation.accept_length(
+                tokens[i, 1: 1 + dlen], tgt[i, :dlen]
+            )
+            if dlen:
+                # zero-draft slots (nothing to look up, no block
+                # slack) are plain decodes, not speculation samples
+                m.spec_proposed += dlen
+                m.spec_accepted += a
+                m.record_spec_accept(a)
+            # emit targets 0..a: the accepted drafts' successors plus
+            # the bonus token the rejected/terminal position scored.
+            # Their K/V is already in the pages (draft j == target j-1
+            # for accepted j); rejected positions' writes are dead —
+            # num_cached stops short of them, every later causal mask
+            # ends at its own query position, and the next write at
+            # that position overwrites.
+            for j in range(a + 1):
+                tok = int(tgt[i, j])
+                req.num_cached += 1
+                req.output_token_ids.append(tok)
+                req.last_token = tok
+                m.decode_tokens += 1
+                reason = req.check_stop(cfg.max_model_len)
+                if reason:
+                    # stop inside the window (EOS mid-draft, length):
+                    # later accepted tokens are discarded unemitted,
+                    # exactly where the plain path would have stopped
+                    self._finish(req, reason, finished)
+                    break
 
     # -- teardown ------------------------------------------------------------
     def _release(self, req):

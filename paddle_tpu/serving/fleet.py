@@ -9,11 +9,15 @@ traffic. ``Fleet`` owns N supervised replicas
 layers the tail-tolerance playbook of Dean & Barroso's "The Tail at
 Scale" over the primitives the previous PRs built:
 
-  * **Health-gated, least-loaded routing** — new requests go to the
-    live replica with the fewest queued+running requests; a replica
-    whose ``Engine.health()`` reports any ``flags`` entry (degraded /
-    overloaded) or a tripped comm watchdog stops receiving new work.
-    Unroutable moments park requests in a fleet-level pending queue.
+  * **Health-gated, hit-aware, least-loaded routing** — a new request
+    prefers the routable replica whose prefix cache holds the longest
+    chain match for its prompt (``Engine.health()`` exports the cached
+    chain digests; a warm system prompt keeps landing where its blocks
+    already live), falling back to the live replica with the fewest
+    queued+running requests; a replica whose health reports any
+    ``flags`` entry (degraded / overloaded) or a tripped comm watchdog
+    stops receiving new work. Unroutable moments park requests in a
+    fleet-level pending queue.
   * **Deterministic crash recovery** — a replica death (unhandled step
     error, watchdog trip, or an injected ``serving.replica`` fault) is
     quarantined; every in-flight request is re-enqueued on a healthy
@@ -52,6 +56,7 @@ from ..observability import flight as _flight
 from ..observability import register_health_provider, span
 from ..resilience import faults
 from .engine import Engine, EngineConfig, EngineOverloadedError
+from .prefix_cache import prompt_chain_digests
 from .request import (
     Request,
     RequestOutput,
@@ -125,6 +130,7 @@ class FleetMetrics:
         self.restarts = 0             # successful rebuilds (crash+rolling)
         self.replicas_failed = 0      # permanent failures (fleet shrank)
         self.route_errors = 0
+        self.route_prefix_hits = 0    # placements won by prefix affinity
         # failover recovery timing (the bench [fleet] row): stamped at
         # death detection and at the first token a re-enqueued request
         # produces on its new replica
@@ -155,6 +161,7 @@ _FLEET_COUNTERS = {
     "restarts": "paddle_tpu_fleet_restarts_total",
     "replicas_failed": "paddle_tpu_fleet_replicas_failed_total",
     "route_errors": "paddle_tpu_fleet_route_errors_total",
+    "route_prefix_hits": "paddle_tpu_fleet_route_prefix_hits_total",
 }
 
 
@@ -253,6 +260,18 @@ class FleetRequest:
         self.hedged = False
         self.done = False
         self.output = None
+        self._chain_digests: dict = {}   # page_size -> prompt digests
+
+    def chain_digests(self, block_size):
+        """This prompt's chain digests at ``block_size`` granularity,
+        hashed once per request lifetime (the hit-aware router matches
+        them against replicas every sweep the request stays parked)."""
+        d = self._chain_digests.get(block_size)
+        if d is None:
+            d = self._chain_digests[block_size] = prompt_chain_digests(
+                self.prompt_token_ids, block_size
+            )
+        return d
 
     @property
     def request_id(self):
@@ -708,6 +727,11 @@ class Fleet:
         # placements land so least-loaded stays balanced within the
         # sweep
         loads = {s: s.load() for s in self.replicas if s.routable()}
+        # per-sweep snapshot of each candidate's cached chain digests
+        # (hit-aware routing): chain_digests() walks the whole cache,
+        # so it is taken at most once per replica per sweep, not per
+        # pending request
+        digests = {}
         while self._pending:
             freq = self._pending[0]
             if freq.done:
@@ -716,18 +740,18 @@ class Fleet:
                 # not be dispatched — and decoded — a second time
                 self._pending.popleft()
                 continue
-            if not self._dispatch_one(freq, loads):
+            if not self._dispatch_one(freq, loads, digests):
                 return
             self._pending.popleft()
 
-    def _dispatch_one(self, freq, loads):
+    def _dispatch_one(self, freq, loads, digests=None):
         """Place one pending request; False leaves it queued (no
         routable replica, admission refused, or an injected
         ``fleet.route`` fault — routing failures degrade to a retry on
         the next step, never to a dropped request)."""
         if not loads:
             return False
-        target = min(loads, key=loads.get)
+        target, affinity = self._route_target(freq, loads, digests)
         try:
             faults.fire(
                 "fleet.route", request_id=freq.request_id,
@@ -748,14 +772,21 @@ class Fleet:
             replica=target.name,
         ):
             try:
-                if freq.request.output_token_ids:
-                    # failed-over mid-generation: KV must be rebuilt
-                    # over prompt + output[:-1] (recompute preemption)
-                    target.engine.resume(freq.request)
-                else:
-                    target.engine.submit(freq.request)
-            except (EngineOverloadedError, RuntimeError):
-                return False  # shed / queue full: stays fleet-pending
+                placed = self._place(freq, target)
+                if not placed and affinity:
+                    # the affinity pick refused admission (warm but
+                    # full): retry least-loaded before parking — under
+                    # plain least-loaded routing a refusal meant
+                    # everyone else was fuller, so halting the sweep
+                    # was right; an affinity refusal says nothing
+                    # about the other candidates
+                    fallback = min(loads, key=loads.get)
+                    if fallback is not target:
+                        placed = self._place(freq, fallback)
+                        if placed:
+                            target, affinity = fallback, False
+                if not placed:
+                    return False  # shed / queue full: stays pending
             except ValueError as e:
                 # unplaceable (admission validation raced an engine
                 # rebuild with a stricter config): fail THIS request
@@ -764,11 +795,84 @@ class Fleet:
                     freq, "error", error=f"{type(e).__name__}: {e}",
                 )
                 return True
+        if affinity:
+            # counted only for PLACEMENTS won by prefix affinity —
+            # refusals and faulted routes must not inflate it
+            self.metrics.route_prefix_hits += 1
         d = _Dispatch(freq, freq.request, target.name, "primary")
         freq.dispatches.append(d)
         self._routes[freq.request.request_id] = d
         loads[target] += 1
         return True
+
+    def _place(self, freq, sup):
+        """Submit (or resume, after a failover) one request on one
+        replica. True = placed; False = admission refused (shed /
+        queue full — retry elsewhere or next step). ValueError
+        propagates: the request itself is unplaceable."""
+        try:
+            if freq.request.output_token_ids:
+                # failed-over mid-generation: KV must be rebuilt
+                # over prompt + output[:-1] (recompute preemption)
+                sup.engine.resume(freq.request)
+            else:
+                sup.engine.submit(freq.request)
+        except (EngineOverloadedError, RuntimeError):
+            return False
+        return True
+
+    def _route_target(self, freq, loads, digests=None):
+        """Hit-aware placement: among the routable candidates
+        (``loads``), prefer the replica whose prefix cache already
+        holds the longest chain match for this prompt — its shared
+        blocks are forked instead of recomputed, which is exactly the
+        prefill compute a least-loaded bounce would throw away. Ties
+        on match length break least-loaded; zero matches anywhere
+        falls back to plain least-loaded. Affinity is load-bounded: a
+        match of n blocks only overrides load while the warm replica
+        carries fewer than n extra requests over the least-loaded
+        candidate — saving n blocks of prefill is not worth queueing
+        behind an arbitrarily deep backlog, so a saturated replica
+        with a shallow match cannot capture all matching traffic.
+        Resume placements (failover) benefit identically: the
+        re-prefill over prompt + output[:-1] starts with the same
+        prompt digests. ``digests`` carries the per-replica digest-set
+        snapshots across one dispatch sweep; the prompt's own digests
+        are cached on the FleetRequest (hashed once per lifetime, not
+        per parked-retry sweep). Returns ``(supervisor,
+        used_affinity)`` — the caller books the prefix-hit counter
+        only once the placement actually lands."""
+        best, best_len = None, 0
+        if digests is None:
+            digests = {}
+        min_load = min(loads.values())
+        for sup in loads:
+            eng = sup.engine
+            if eng is None or eng.prefix_cache is None:
+                continue
+            bs = eng.config.page_size
+            want = freq.chain_digests(bs)
+            if not want:
+                continue
+            have = digests.get(sup.name)
+            if have is None:
+                have = digests[sup.name] = set(
+                    eng.prefix_cache.chain_digests()
+                )
+            n = 0
+            for d in want:
+                if d not in have:
+                    break
+                n += 1
+            if loads[sup] - min_load >= n:
+                continue  # too backlogged for what the match saves
+            if n > best_len or (
+                n == best_len and n > 0 and loads[sup] < loads[best]
+            ):
+                best, best_len = sup, n
+        if best is not None and best_len > 0:
+            return best, True
+        return min(loads, key=loads.get), False
 
     def _maybe_hedge(self, now):
         deadline = self.config.hedge_after_s

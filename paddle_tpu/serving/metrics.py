@@ -41,6 +41,11 @@ _EXPORT_KINDS = {
     "prefill_ext_compiles": ("counter", "_total"),
     "decode_compiles": ("counter", "_total"),
     "cow_compiles": ("counter", "_total"),
+    "verify_compiles": ("counter", "_total"),
+    "verify_steps": ("counter", "_total"),
+    "spec_proposed": ("counter", "_total"),
+    "spec_accepted": ("counter", "_total"),
+    "spec_accept_rate": ("gauge", ""),
     "prefix_lookups": ("counter", "_total"),
     "prefix_hits": ("counter", "_total"),
     "prefix_hit_tokens": ("counter", "_total"),
@@ -80,6 +85,23 @@ def _register_view(metrics, engine_id):
             fams.append(MetricFamily(
                 f"paddle_tpu_serving_{key}{suffix}", kind,
             ).add(value, label))
+        hist = m.spec_accept_hist()
+        if hist:
+            # per-step accepted-draft-length histogram (Prometheus
+            # cumulative-bucket semantics; the observed lengths 0..K
+            # ARE the bucket bounds, so every sample lands exactly)
+            fam = MetricFamily(
+                "paddle_tpu_serving_spec_accept_length", "histogram",
+            )
+            acc, total = 0, 0.0
+            for le in sorted(hist):
+                acc += hist[le]
+                total += le * hist[le]
+                fam.add(acc, {**label, "le": str(le)}, "_bucket")
+            fam.add(acc, {**label, "le": "+Inf"}, "_bucket")
+            fam.add(total, label, "_sum")
+            fam.add(acc, label, "_count")
+            fams.append(fam)
         return fams
 
     get_registry().register_collector(f"serving.engine.{engine_id}",
@@ -122,6 +144,15 @@ class EngineMetrics:
         self.prefill_ext_compiles = 0
         self.decode_compiles = 0
         self.cow_compiles = 0
+        self.verify_compiles = 0
+        # speculative decoding: verify launches, draft tokens proposed
+        # by the prompt-lookup drafter, and drafts the target argmax
+        # accepted (the spec_accept_rate numerator); the per-step
+        # accepted-length distribution feeds the histogram view
+        self.verify_steps = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self._spec_accept_counts: dict = {}
         # gauges (updated by the engine each step)
         self.queue_depth = 0
         self.num_running = 0
@@ -146,6 +177,26 @@ class EngineMetrics:
     def record_ttft(self, seconds):
         self._ttft_sum += seconds
         self._ttft_count += 1
+
+    def record_spec_accept(self, n):
+        """One verify launch accepted ``n`` draft tokens for one
+        slot."""
+        n = int(n)
+        self._spec_accept_counts[n] = (
+            self._spec_accept_counts.get(n, 0) + 1
+        )
+
+    def spec_accept_hist(self):
+        """{accepted_length: observations} — the histogram view's
+        source (copied so scrapes never race the accept loop)."""
+        return dict(self._spec_accept_counts)
+
+    @property
+    def spec_accept_rate(self):
+        return (
+            self.spec_accepted / self.spec_proposed
+            if self.spec_proposed else None
+        )
 
     @property
     def mean_ttft(self):
@@ -179,10 +230,15 @@ class EngineMetrics:
             "prefill_steps": self.prefill_steps,
             "prefill_chunks": self.prefill_chunks,
             "decode_steps": self.decode_steps,
+            "verify_steps": self.verify_steps,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
+            "spec_accept_rate": self.spec_accept_rate,
             "prefill_compiles": self.prefill_compiles,
             "prefill_ext_compiles": self.prefill_ext_compiles,
             "decode_compiles": self.decode_compiles,
             "cow_compiles": self.cow_compiles,
+            "verify_compiles": self.verify_compiles,
             "cache_utilization": self.cache_utilization,
             "kv_active_utilization": self.kv_active_utilization,
             "kv_reclaimable_blocks": self.kv_reclaimable_blocks,

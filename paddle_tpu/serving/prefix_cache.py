@@ -36,7 +36,36 @@ from __future__ import annotations
 import hashlib
 from collections import OrderedDict
 
-__all__ = ["PrefixCache", "PrefixMatch"]
+__all__ = ["PrefixCache", "PrefixMatch", "prompt_chain_digests"]
+
+# wire form of a chain key: enough hex to make collisions negligible
+# at fleet scale while keeping health() snapshots compact
+_DIGEST_HEX = 16
+
+
+def _iter_chain(tokens, block_size):
+    """Yield ``(digest, block_index)`` for each FULL block of
+    ``tokens``: the digest folds the parent digest in, so equal blocks
+    in different prefix contexts never collide. The single hashing
+    implementation behind both the cache's keys and the router-facing
+    wire digests — they MUST agree byte-for-byte."""
+    h = b""
+    for i in range(len(tokens) // block_size):
+        payload = " ".join(
+            str(int(t)) for t in tokens[i * block_size:(i + 1) * block_size]
+        )
+        h = hashlib.sha256(h + payload.encode()).digest()
+        yield h, i
+
+
+def prompt_chain_digests(tokens, block_size):
+    """Chain digests (hex wire form) of every full block of ``tokens``
+    — what a router hashes a request's prompt into to match it against
+    the ``prefix_cache_digests`` a replica exports via
+    ``Engine.health()``."""
+    return [
+        h.hex()[:_DIGEST_HEX] for h, _i in _iter_chain(tokens, block_size)
+    ]
 
 
 class PrefixMatch:
@@ -99,23 +128,29 @@ class PrefixCache:
         # first; lookup/register touches move entries to the end)
         self._entries: OrderedDict = OrderedDict()
         self._metrics = metrics
+        self._digest_cache = ()   # rebuilt lazily after insert/evict
 
     def __len__(self):
         return len(self._entries)
 
     # -- chain keys ----------------------------------------------------------
     def _chain(self, tokens):
-        """Yield ``(digest, block_index)`` for each FULL block of
-        ``tokens``. The digest folds the parent digest in, so equal
-        blocks in different prefix contexts never collide."""
-        h = b""
-        bs = self._bs
-        for i in range(len(tokens) // bs):
-            payload = " ".join(
-                str(int(t)) for t in tokens[i * bs:(i + 1) * bs]
+        """``_iter_chain`` over this cache's block size."""
+        return _iter_chain(tokens, self._bs)
+
+    def chain_digests(self):
+        """Hex chain keys of every cached entry, insertion (= chain)
+        order — the ``Engine.health()`` export a hit-aware router
+        matches ``prompt_chain_digests`` results against. Cached
+        between membership changes: ``health()`` sits on the fleet's
+        per-step routability path, so this must not walk the cache on
+        every call."""
+        if self._digest_cache is None:
+            self._digest_cache = tuple(
+                e.digest.hex()[:_DIGEST_HEX]
+                for e in self._entries.values()
             )
-            h = hashlib.sha256(h + payload.encode()).digest()
-            yield h, i
+        return self._digest_cache
 
     # -- match ---------------------------------------------------------------
     def lookup(self, tokens, limit):
@@ -179,6 +214,7 @@ class PrefixCache:
             self._bm.fork([block])  # the cache's own reference
             e = _Entry(digest, block, parent)
             self._entries[digest] = e
+            self._digest_cache = None
             if parent is not None:
                 parent.children += 1
             parent = e
@@ -187,6 +223,7 @@ class PrefixCache:
     # -- eviction / reclaim --------------------------------------------------
     def _evict(self, digest):
         e = self._entries.pop(digest)
+        self._digest_cache = None
         if e.parent is not None:
             e.parent.children -= 1
         self._bm.free([e.block])

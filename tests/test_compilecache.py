@@ -567,7 +567,7 @@ class TestFailurePaths:
 PFX_CFG = dict(
     max_batch_slots=2, max_model_len=32, page_size=4,
     prefill_buckets=[8, 32], enable_prefix_cache=True,
-    prefill_chunk_tokens=8,
+    prefill_chunk_tokens=8, speculate_tokens=2,
 )
 
 
@@ -584,6 +584,7 @@ def warm_pfx_cache(model, tmp_path_factory):
     assert m.prefill_ext_compiles >= 1
     assert m.decode_compiles == 1
     assert m.cow_compiles == 1
+    assert m.verify_compiles == 1
     return str(root), cold
 
 
@@ -599,7 +600,8 @@ class TestPrefixCacheWarmRestart:
         with open(os.path.join(mdir, mname)) as f:
             entries = json.load(f)["entries"]
         kinds = sorted(set(e["kind"] for e in entries))
-        assert kinds == ["cow", "decode", "prefill", "prefill_ext"]
+        assert kinds == ["cow", "decode", "prefill", "prefill_ext",
+                         "verify"]
         ext_buckets = sorted(
             e["bucket"] for e in entries if e["kind"] == "prefill_ext"
         )
@@ -616,9 +618,9 @@ class TestPrefixCacheWarmRestart:
         eng = Engine(model, _engine_config(root, **PFX_CFG))
         m = eng.metrics
         probe = (m.prefill_compiles, m.prefill_ext_compiles,
-                 m.decode_compiles, m.cow_compiles)
-        assert probe == (0, 0, 0, 0)
-        assert jit_events.aot_hits() >= hits0 + 6  # 2+2 prefill, decode, cow
+                 m.decode_compiles, m.cow_compiles, m.verify_compiles)
+        assert probe == (0, 0, 0, 0, 0)
+        assert jit_events.aot_hits() >= hits0 + 7  # 2+2 pf, decode, cow, verify
         # serving through the warm programs: bit-identical, still zero
         # traces — cache hits, chunked prefill and COW all replay AOT
         assert _tokens(eng) == cold
@@ -626,8 +628,8 @@ class TestPrefixCacheWarmRestart:
         assert eng.metrics.prefix_hit_tokens > 0
         assert eng.metrics.cow_copies >= 1
         probe = (m.prefill_compiles, m.prefill_ext_compiles,
-                 m.decode_compiles, m.cow_compiles)
-        assert probe == (0, 0, 0, 0)
+                 m.decode_compiles, m.cow_compiles, m.verify_compiles)
+        assert probe == (0, 0, 0, 0, 0)
 
 
 class TestWarmCLI:
@@ -645,7 +647,7 @@ class TestWarmCLI:
         root, _ = warm_pfx_cache
         assert main(["warm", "--manifest", self._manifest_path(root)]) == 0
         out = capsys.readouterr().out
-        assert "6/6 programs present" in out
+        assert "7/7 programs present" in out
 
     def test_warm_reports_missing_without_builder(
         self, warm_pfx_cache, tmp_path, capsys
@@ -662,7 +664,7 @@ class TestWarmCLI:
         ArtifactStore(dst).remove(decode["store_key"])
         assert main(["warm", "--manifest", mpath]) == 3
         out = capsys.readouterr().out
-        assert "MISSING" in out and "5/6 programs present" in out
+        assert "MISSING" in out and "6/7 programs present" in out
 
     def test_warm_builder_compiles_missing_entries(
         self, warm_pfx_cache, tmp_path, monkeypatch, capsys
@@ -706,8 +708,8 @@ class TestWarmCLI:
         ])
         assert rc == 0
         out = capsys.readouterr().out
-        assert "1/6 program(s) missing" in out
-        assert "6/6 programs present" in out
+        assert "1/7 program(s) missing" in out
+        assert "7/7 programs present" in out
         assert ArtifactStore(dst).contains(cow["store_key"])
 
 

@@ -437,3 +437,35 @@ class TestFleetAPI:
             if r is not victim:
                 assert r.output.finish_reason == "length"
         assert fleet.metrics.failovers == 1
+
+
+class TestHitAwareRouting:
+    """Prefix-affinity routing: a repeated system prompt routes to the
+    replica whose prefix cache already holds its blocks, instead of
+    bouncing to the least-loaded cold replica and recomputing it."""
+
+    def test_repeated_system_prompt_routes_to_warm_replica(self, model):
+        fleet = Fleet(model, _engine_config(enable_prefix_cache=True),
+                      FleetConfig(num_replicas=2, analysis_check=None))
+        sys_prefix = list(range(40, 52))        # 3 full blocks
+        params = SamplingParams(max_new_tokens=2)
+        fleet.generate([sys_prefix + [90, 91]], params)
+        warm = next(
+            s for s in fleet.replicas
+            if s.engine.metrics.prefill_tokens > 0
+        )
+        cold = next(s for s in fleet.replicas if s is not warm)
+        # the published chain is visible on the health surface the
+        # router (and an external balancer) matches against
+        digests = warm.engine.health()["prefix_cache_digests"]
+        assert len(digests) == 3
+        assert not cold.engine.health()["prefix_cache_digests"]
+        # same prefix again: least-loaded alone could pick either
+        # replica — affinity must pick the warm one and fork its blocks
+        outs = fleet.generate([sys_prefix + [95, 96]], params)
+        assert outs[0].finish_reason == "length"
+        assert fleet.metrics.route_prefix_hits >= 1
+        assert warm.engine.metrics.prefix_hits >= 1
+        assert cold.engine.metrics.prefill_tokens == 0
+        snap = fleet.snapshot()
+        assert snap["route_prefix_hits"] >= 1

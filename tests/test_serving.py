@@ -788,6 +788,261 @@ class TestPrefixCacheChunkedPrefill:
             ))
 
 
+@pytest.fixture(scope="module")
+def spec_engine(model):
+    """Shared speculative-decoding engine (K=3 prompt-lookup drafts
+    through the VERIFY program). Drains completely between tests, so
+    only counters persist; program set: prefill per bucket, ONE verify,
+    and the mixed decode variant for sampled slots."""
+    return Engine(model, EngineConfig(
+        max_batch_slots=4, max_model_len=32, page_size=4,
+        num_blocks=48, prefill_buckets=[16, 32], speculate_tokens=3,
+        seed=3,
+    ))
+
+
+class TestSpeculativeDecoding:
+    """Tentpole acceptance: n-gram drafting + batched verification
+    emit byte-identical greedy streams to ``generate`` and to a
+    spec-disabled engine, through ONE verify trace — mixed accept
+    counts, rejects, EOS-mid-draft, TTL and preemption included."""
+
+    def test_drafter_unit(self):
+        from paddle_tpu.serving.speculation import accept_length, propose
+
+        # period-4 cycle: the full-K continuation is preferred over the
+        # flush-against-the-tail match that would truncate the draft
+        hist = [1, 2, 3, 4] * 4
+        assert propose(hist, 6) == [1, 2, 3, 4, 1, 2]
+        # disagreeing variants truncate at the common prefix: both
+        # occurrences of trailing [9, 5] continue 6, then diverge
+        hist = [9, 5, 6, 1, 9, 5, 6, 2, 9, 5]
+        assert propose(hist, 3, max_ngram=2) == [6]
+        # no repetition to exploit / no budget -> no draft
+        assert propose([1, 2, 3, 4, 5], 4) == []
+        assert propose([1, 2] * 4, 0) == []
+        # near-tail fallback: single short match still drafts
+        assert propose([7, 8, 9, 7, 8], 4, max_ngram=2) == [9, 7, 8]
+        # acceptance: sticky-reject semantics
+        assert accept_length([5, 6, 7], [5, 6, 7]) == 3
+        assert accept_length([5, 9, 7], [5, 6, 7]) == 1
+        assert accept_length([9, 6, 7], [5, 6, 7]) == 0
+        assert accept_length([], [5, 6]) == 0
+
+    def test_mixed_workload_parity_and_compile_probe(
+        self, model, small_engine, spec_engine
+    ):
+        """The 32-request workload with every 4th request SAMPLED:
+        greedy outputs byte-match generate() AND the spec-disabled
+        engine; compile probes pin one verify trace and zero warm
+        retraces."""
+        from paddle_tpu.observability import jit_events
+
+        prompts, max_new, _arrivals = _mixed_workload()
+        params = [
+            SamplingParams(max_new_tokens=k, do_sample=(i % 4 == 3),
+                           temperature=0.8, top_k=20)
+            for i, k in enumerate(max_new)
+        ]
+        retr0 = jit_events.retraces_after_warmup()
+        outs_spec = spec_engine.generate(prompts, params)
+        outs_plain = small_engine.generate(prompts, params)
+        oracle_budget = 8   # the plain engine is itself oracle-checked
+        for o_s, o_p, p, k, sp in zip(
+            outs_spec, outs_plain, prompts, max_new, params
+        ):
+            if sp.do_sample:
+                # sampled slots keep the plain decode path: valid draws
+                # (key streams differ between engines, so no byte
+                # parity is promised — see docs/serving.md)
+                assert len(o_s.token_ids) == k
+                assert all(0 <= t < 128 for t in o_s.token_ids)
+            else:
+                # EVERY greedy request byte-matches the spec-disabled
+                # engine; a subsample also hits generate() directly
+                # (TestMixedWorkload pins plain == generate on these
+                # same length combos — oracle calls are the expensive
+                # part of this test, tier-1 budget)
+                assert o_s.token_ids == o_p.token_ids, ("spec", p)
+                if oracle_budget > 0:
+                    oracle_budget -= 1
+                    assert o_s.token_ids == _generate_oracle(
+                        model, p, k
+                    ), ("oracle", p)
+        m = spec_engine.metrics
+        # ONE verify trace ever; the decode family stays within its
+        # usual two static variants (sampled slots use the mixed one;
+        # draft-less steps fall back to the greedy-only one); drafting
+        # actually happened
+        assert m.verify_compiles == 1
+        assert m.decode_compiles <= 2
+        assert m.prefill_compiles <= 2
+        assert m.spec_proposed > 0
+        assert m.verify_steps > 0
+        assert jit_events.retraces_after_warmup() == retr0
+        assert spec_engine.block_manager.num_used == 0
+
+    def test_forced_accept_reject_and_eos_mid_draft(
+        self, model, spec_engine, monkeypatch
+    ):
+        """Deterministic accept/reject edge cases via a controlled
+        drafter: an oracle-fed drafter drives all-K acceptance (and an
+        EOS inside an accepted draft), an always-wrong drafter drives
+        0-accepted — byte parity must hold through all of them."""
+        from paddle_tpu.serving import engine as engine_mod
+
+        prompt = [3, 17, 42, 99]
+        ref = _generate_oracle(model, prompt, 12)
+
+        def feeding(history, k, **kw):
+            done = [int(t) for t in history[len(prompt):]]
+            if [int(t) for t in history[:len(prompt)]] == prompt and (
+                ref[:len(done)] == done
+            ):
+                return ref[len(done):len(done) + k]
+            return []
+
+        monkeypatch.setattr(engine_mod.speculation, "propose", feeding)
+        m = spec_engine.metrics
+        v0, a0, p0 = m.verify_steps, m.spec_accepted, m.spec_proposed
+        out = spec_engine.generate(
+            [prompt], SamplingParams(max_new_tokens=12)
+        )[0]
+        assert out.token_ids == ref
+        # all-K acceptance: 12 tokens in far fewer launches than the
+        # plain path's 11 decode steps (K+1 = 4 tokens per launch once
+        # drafts flow)
+        assert m.verify_steps - v0 <= 5
+        assert m.spec_accepted - a0 >= 8
+        # EOS inside an accepted draft window: stop exactly where the
+        # plain path would, discarding the accepted remainder
+        out = spec_engine.generate(
+            [prompt],
+            SamplingParams(max_new_tokens=12, eos_token_id=ref[5]),
+        )[0]
+        assert out.token_ids == ref[:6]
+        assert out.finish_reason == "stop"
+
+        def wrong(history, k, **kw):
+            done = [int(t) for t in history[len(prompt):]]
+            if [int(t) for t in history[:len(prompt)]] == prompt and (
+                ref[:len(done)] == done
+            ):
+                return [(t + 1) % 128 for t in ref[len(done):len(done) + k]]
+            return []
+
+        monkeypatch.setattr(engine_mod.speculation, "propose", wrong)
+        a0, p1 = m.spec_accepted, m.spec_proposed
+        out = spec_engine.generate(
+            [prompt], SamplingParams(max_new_tokens=12)
+        )[0]
+        assert out.token_ids == ref          # rejects are invisible
+        assert m.spec_accepted == a0         # 0-accepted throughout
+        assert m.spec_proposed > p1
+        assert spec_engine.block_manager.num_used == 0
+
+    def test_ttl_and_preemption_mid_spec(self, model, spec_engine):
+        """TTL expiry finishes a speculating request with "timeout";
+        a pool too small for the running set preempts mid-speculation
+        and greedy outputs stay byte-identical."""
+        running = spec_engine.add_request(
+            [6, 7, 6, 7], SamplingParams(max_new_tokens=12)
+        )
+        spec_engine.step()
+        running.deadline = 0.0               # expire mid-flight
+        out = _drain(spec_engine)
+        assert out[running.request_id].finish_reason == "timeout"
+        assert spec_engine.block_manager.num_used == 0
+
+        engine = Engine(model, EngineConfig(
+            max_batch_slots=4, max_model_len=32, page_size=4,
+            num_blocks=10, prefill_buckets=[32], speculate_tokens=3,
+            seed=3,
+        ))
+        rng = np.random.default_rng(7)
+        lens = [int(n) for n in rng.choice([4, 7, 10], 6)]
+        prompts = [rng.integers(1, 128, n).tolist() for n in lens]
+        max_new = [16 - n for n in lens]
+        outs = engine.generate(
+            prompts,
+            [SamplingParams(max_new_tokens=k) for k in max_new],
+        )
+        assert engine.metrics.preemptions >= 1
+        for o, p, k in zip(outs, prompts, max_new):
+            assert o.token_ids == _generate_oracle(model, p, k)
+        assert engine.block_manager.num_used == 0
+
+    def test_spec_observability_and_health(self, spec_engine):
+        """spec_* counters reach the registry view (histogram
+        included) and health() reports the accept rate."""
+        from paddle_tpu.observability import get_registry
+
+        m = spec_engine.metrics
+        assert m.spec_proposed > 0           # earlier tests drafted
+        assert m.spec_accept_hist()
+        rate = spec_engine.health()["spec_accept_rate"]
+        assert rate is not None and 0.0 <= rate <= 1.0
+        text = get_registry().render_prometheus()
+        for needle in (
+            "paddle_tpu_serving_spec_proposed_total",
+            "paddle_tpu_serving_spec_accepted_total",
+            "paddle_tpu_serving_verify_steps_total",
+            "paddle_tpu_serving_spec_accept_length_bucket",
+            "paddle_tpu_serving_spec_accept_length_count",
+        ):
+            assert needle in text, needle
+
+    def test_check_verify_gate(self, small_engine, spec_engine):
+        """The analysis gate for the verify program: zero host-sync /
+        retrace findings, trace-only (probes unmoved), and clear
+        errors for misuse."""
+        m = spec_engine.metrics
+        before = (m.verify_compiles, m.decode_compiles)
+        report = spec_engine.check_verify("error")
+        assert not report.by_rule("host-sync")
+        assert not report.by_rule("retrace-hazard")
+        assert (m.verify_compiles, m.decode_compiles) == before
+        with pytest.raises(ValueError, match="mode"):
+            spec_engine.check_verify("loud")
+        with pytest.raises(RuntimeError, match="speculate_tokens"):
+            small_engine.check_verify()
+
+    def test_spec_config_validation_and_adapter_gate(self, model):
+        with pytest.raises(ValueError, match="speculate_tokens"):
+            EngineConfig(max_model_len=32, speculate_tokens=0)
+        with pytest.raises(ValueError, match="speculate_tokens"):
+            EngineConfig(max_model_len=32, speculate_tokens=32)
+        with pytest.raises(ValueError, match="speculate_ngram"):
+            EngineConfig(max_model_len=32, speculate_ngram=0)
+
+        class MinimalAdapter:
+            """Duck-typed adapter without the optional entry points."""
+            import jax.numpy as _jnp
+
+            num_layers, num_kv_heads, head_dim, vocab_size = 1, 1, 4, 8
+            weights = {"embed": _jnp.zeros((8, 4), "float32")}
+
+            def prefill(self, *a):
+                raise NotImplementedError
+
+            def decode(self, *a):
+                raise NotImplementedError
+
+        # ONE clear TypeError naming the missing method AND the flag
+        with pytest.raises(TypeError, match="verify") as ei:
+            Engine(MinimalAdapter(), EngineConfig(
+                max_batch_slots=1, max_model_len=16, page_size=4,
+                speculate_tokens=2,
+            ))
+        assert "speculate_tokens" in str(ei.value)
+        with pytest.raises(TypeError, match="prefill_ext") as ei:
+            Engine(MinimalAdapter(), EngineConfig(
+                max_batch_slots=1, max_model_len=16, page_size=4,
+                enable_prefix_cache=True,
+            ))
+        assert "enable_prefix_cache" in str(ei.value)
+
+
 class TestPrefixCacheUnit:
     """Host-only BlockManager + PrefixCache invariants: refcount safety
     under sharing, chain-keyed matching, LRU eviction returning blocks
