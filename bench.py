@@ -259,6 +259,109 @@ def bench_moe(paddle, on_tpu, peak):
     return tps
 
 
+def bench_kernels(paddle, on_tpu, peak):
+    """[kernels] row — the fused hot-path kernel lane (ISSUE 12):
+    ragged (dropless grouped_matmul) vs dense (capacity-padded einsum)
+    MoE layer throughput, paged decode-attention kernel throughput, and
+    the int8 KV-cache byte budget. On TPU the Pallas kernels run; on
+    CPU the XLA fallbacks run (the exact code path tier-1 exercises),
+    so the CPU smoke quantifies the dispatch-layer win (no capacity
+    padding) while the TPU run adds the kernel win."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.incubate import MoELayer
+
+    # --- ragged vs dense MoE layer (forward, staged) ------------------
+    if on_tpu:
+        d_model, d_ff, e, k, b, s = 1024, 2816, 8, 2, 8, 1024
+    else:
+        d_model, d_ff, e, k, b, s = 64, 256, 8, 2, 2, 512
+    layers = {}
+    for impl in ("dense", "ragged"):
+        paddle.seed(0)
+        layers[impl] = MoELayer(
+            d_model=d_model, num_experts=e, d_ff=d_ff, k=k, impl=impl,
+        )
+    x = paddle.to_tensor(np.random.RandomState(0).randn(
+        b, s, d_model
+    ).astype(np.float32))
+    tps = {}
+    for impl, layer in layers.items():
+        staged = paddle.jit.to_static(
+            lambda t, _l=layer: _l(t)[0], full_graph=True
+        )
+        staged(x)  # compile
+        dt = _timed_steps(
+            lambda: staged(x), 5, lambda o: o.numpy(), warmup=3,
+        )
+        tps[impl] = b * s / dt
+        log(f"[kernels] moe_{impl}: {b * s} tokens in {dt*1e3:.1f}ms "
+            f"-> {tps[impl]:,.0f} tokens/s")
+    speedup = tps["ragged"] / tps["dense"]
+    log(f"[kernels] ragged vs dense speedup: {speedup:.2f}x")
+    print(json.dumps({
+        "metric": "moe_ragged_tokens_per_s",
+        "value": round(tps["ragged"]), "unit": "tokens/s",
+    }))
+    print(json.dumps({
+        "metric": "moe_ragged_vs_dense_speedup",
+        "value": round(speedup, 3), "unit": "x",
+    }))
+
+    # --- paged decode attention kernel --------------------------------
+    from paddle_tpu.kernels.pallas.paged_attention import (
+        paged_attention, paged_attention_xla,
+    )
+
+    if on_tpu:
+        batch, kvh, qh, d, pages, bs_pg, pps = 64, 8, 32, 128, 2048, 16, 64
+    else:
+        batch, kvh, qh, d, pages, bs_pg, pps = 8, 2, 8, 64, 64, 16, 8
+    rng = np.random.RandomState(1)
+    kp = jnp.asarray(rng.randn(kvh, pages, bs_pg, d).astype(np.float32))
+    vp = jnp.asarray(rng.randn(kvh, pages, bs_pg, d).astype(np.float32))
+    q = jnp.asarray(rng.randn(batch, qh, d).astype(np.float32))
+    bt = jnp.asarray(
+        rng.randint(0, pages, (batch, pps)).astype(np.int32)
+    )
+    lens = jnp.asarray(
+        rng.randint(1, pps * bs_pg, batch).astype(np.int32)
+    )
+    kern = paged_attention if on_tpu else paged_attention_xla
+    f = jax.jit(lambda *a: kern(*a))
+    jax.block_until_ready(f(q, kp, vp, bt, lens))
+    t0 = time.perf_counter()
+    iters = 50
+    for _ in range(iters):
+        out = f(q, kp, vp, bt, lens)
+    jax.block_until_ready(out)
+    dk_tps = batch * iters / (time.perf_counter() - t0)
+    log(f"[kernels] paged decode attention ({'pallas' if on_tpu else 'xla'}"
+        f" path): {dk_tps:,.0f} tokens/s (batch={batch} ctx<="
+        f"{pps * bs_pg})")
+    print(json.dumps({
+        "metric": "decode_paged_kernel_tokens_per_s",
+        "value": round(dk_tps), "unit": "tokens/s",
+    }))
+
+    # --- int8 KV byte budget ------------------------------------------
+    from paddle_tpu.serving import KVPool
+
+    layers_n = 8
+    fp = KVPool(layers_n, kvh, pages, bs_pg, d, "float32")
+    q8 = KVPool(layers_n, kvh, pages, bs_pg, d, "float32",
+                quant_dtype="int8")
+    ratio = fp.bytes_per_token() / q8.bytes_per_token()
+    log(f"[kernels] kv bytes/token: fp32 {fp.bytes_per_token():.0f} -> "
+        f"int8 {q8.bytes_per_token():.0f} ({ratio:.2f}x)")
+    print(json.dumps({
+        "metric": "kv_int8_bytes_per_token",
+        "value": round(q8.bytes_per_token(), 1), "unit": "bytes",
+    }))
+    return tps["ragged"]
+
+
 def bench_resnet(paddle, on_tpu):
     """ResNet-50 training throughput (BASELINE config #1 row)."""
     import paddle_tpu.nn as nn
@@ -966,6 +1069,7 @@ ROWS = {
     "serving": lambda p, tpu, peak: bench_serving(p, tpu),
     "fleet": lambda p, tpu, peak: bench_fleet(p, tpu),
     "moe": lambda p, tpu, peak: bench_moe(p, tpu, peak),
+    "kernels": lambda p, tpu, peak: bench_kernels(p, tpu, peak),
     "resnet": lambda p, tpu, peak: bench_resnet(p, tpu),
     "dit": lambda p, tpu, peak: bench_dit(p, tpu),
     "compilecache": lambda p, tpu, peak: bench_compilecache(p, tpu),
@@ -1068,7 +1172,8 @@ def main():
 
         for name in ("decode", "serving", "fleet", "compilecache",
                      "resilience", "train_resume", "analysis",
-                     "observability", "moe", "resnet", "dit"):
+                     "observability", "kernels", "moe", "resnet",
+                     "dit"):
             try:
                 if name == "moe":
                     # shrink ladder: retry in fresh subprocesses until a

@@ -14,14 +14,22 @@ Rules:
                        function reachable from a traced region: the
                        value is baked at trace time and frozen into the
                        compiled program
+    host-sync-in-traced  ``jax.device_get(...)`` /
+                       ``.block_until_ready()`` inside a
+                       traced-reachable function: a device round-trip
+                       on the hot path — a graph break when tracing, a
+                       pipeline stall when eager. Deliberate
+                       dynamic-shape breaks carry an allow comment.
     global-mutation    ``global`` declaration inside a traced-reachable
                        function: module state mutated at trace time, not
                        per execution
 
 "Traced region" is approximated conservatively (precision over recall):
 roots are functions decorated with ``to_static``/``jit``/``jax.jit``/
-``bucketize`` plus every function in ``ops/impl`` and ``kernels`` (pure
-traced op bodies); reachability follows same-module direct calls
+``bucketize`` plus every function in ``ops/impl`` and ``kernels`` —
+including the ``kernels/pallas`` kernel bodies and their host-side
+launch wrappers, which trace into every serving program; reachability
+follows same-module direct calls
 (``name(...)`` to a module function, ``self.name(...)`` to a method of
 the same class).
 
@@ -96,6 +104,8 @@ class _Module:
         self.time_aliases = set()     # names bound to the time module
         self.np_aliases = set()       # names bound to numpy
         self.np_random_aliases = set()  # names bound to numpy.random
+        self.jax_aliases = set()      # names bound to the jax module
+        self.device_get_aliases = set()  # from jax import device_get
         self._collect(tree)
 
     def _collect(self, tree):
@@ -121,6 +131,8 @@ class _Module:
                 bound = alias.asname or alias.name.split(".")[0]
                 if alias.name == "time":
                     self.time_aliases.add(bound)
+                elif alias.name == "jax":
+                    self.jax_aliases.add(bound)
                 elif alias.name == "numpy":
                     self.np_aliases.add(bound)
                 elif alias.name == "numpy.random":
@@ -134,6 +146,12 @@ class _Module:
                 for alias in node.names:
                     if alias.name == "random":
                         self.np_random_aliases.add(
+                            alias.asname or alias.name
+                        )
+            elif node.module == "jax":
+                for alias in node.names:
+                    if alias.name == "device_get":
+                        self.device_get_aliases.add(
                             alias.asname or alias.name
                         )
 
@@ -237,6 +255,28 @@ def _nondet_calls(mod, node):
             yield sub, f"{v.id}.{f.attr}()"
 
 
+def _host_sync_calls(mod, node):
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if isinstance(f, ast.Name):
+            # device_get(...) imported from jax
+            if f.id in mod.device_get_aliases:
+                yield sub, f"{f.id}()"
+            continue
+        if not isinstance(f, ast.Attribute):
+            continue
+        v = f.value
+        # jax.device_get(...)
+        if (isinstance(v, ast.Name) and v.id in mod.jax_aliases
+                and f.attr == "device_get"):
+            yield sub, f"{v.id}.device_get()"
+        # <anything>.block_until_ready()
+        elif f.attr == "block_until_ready":
+            yield sub, ".block_until_ready()"
+
+
 def _traced_rules(mod, relpath, lines, filename):
     roots = _roots(mod, relpath)
     if not roots:
@@ -256,6 +296,23 @@ def _traced_rules(mod, relpath, lines, filename):
                     "region): the value is read ONCE at trace time and "
                     "frozen into the compiled program; thread it in as "
                     "an argument or use the staged RNG"
+                ),
+                file=filename,
+                line=call.lineno,
+            )
+        for call, desc in _host_sync_calls(mod, node):
+            if _allowed(lines, call.lineno, "host-sync-in-traced"):
+                continue
+            yield Finding(
+                rule="host-sync-in-traced",
+                severity=Severity.WARNING,
+                message=(
+                    f"{desc} inside `{qual}` (reachable from a traced "
+                    "region): a host-device round-trip on the hot path "
+                    "— a graph break when tracing, a pipeline stall "
+                    "when eager; keep data on device or annotate the "
+                    "deliberate break with `# analysis: "
+                    "allow(host-sync-in-traced) <reason>`"
                 ),
                 file=filename,
                 line=call.lineno,

@@ -96,5 +96,6 @@ define_flag("FLAGS_allocator_strategy", "auto_growth", "kept for parity; PJRT ow
 define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "parity alias; see XLA_PYTHON_CLIENT_MEM_FRACTION")
 define_flag("FLAGS_use_pallas_kernels", True, "use Pallas kernels (flash-attn, rmsnorm, rope) when on TPU")
 define_flag("FLAGS_flash_attention_min_seq", 2048, "route sdpa to the Pallas flash kernel at seq >= this (below it XLA's fused attention wins; above it O(s^2) score materialization is prohibitive)")
+define_flag("FLAGS_pallas_interpret", False, "off-TPU, run explicitly requested Pallas kernels (decode_kernel='pallas') under the Pallas interpreter instead of degrading to the XLA fallback (parity testing)")
 define_flag("FLAGS_jit_donate_buffers", True, "donate input buffers in compiled train steps")
 define_flag("FLAGS_prim_all", False, "decompose ops into primitives before compile")

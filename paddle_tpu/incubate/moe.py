@@ -136,13 +136,44 @@ class SwiGLUExperts(Layer):
         self.w_gate = mk([num_experts, d_model, d_ff])
         self.w_up = mk([num_experts, d_model, d_ff])
         self.w_down = mk([num_experts, d_ff, d_model])
+        # weight-only int8 state (quantization.quantize_moe_experts):
+        # None until quantized, then one f32 per-expert-per-channel
+        # scale Tensor per projection. Registered as BUFFERS so a
+        # quantized model's state_dict carries the scales next to the
+        # int8 weights (quantize the target layer before loading one).
+        self.register_buffer("w_gate_scale", None)
+        self.register_buffer("w_up_scale", None)
+        self.register_buffer("w_down_scale", None)
+
+    @property
+    def quantized(self):
+        return self.w_gate_scale is not None
 
     def forward(self, dispatched):
         """dispatched: [e, c, m] -> [e, c, m]."""
+        if self.quantized:
+            raise RuntimeError(
+                "int8-quantized experts only run through the ragged "
+                'path: use MoELayer(impl="ragged")'
+            )
         g = F.einsum("ecm,emf->ecf", dispatched, self.w_gate)
         u = F.einsum("ecm,emf->ecf", dispatched, self.w_up)
         h = F.swiglu(g, u)
         return F.einsum("ecf,efm->ecm", h, self.w_down)
+
+    def forward_ragged(self, x_sorted, group_sizes, impl="auto"):
+        """Ragged form: ``x_sorted`` [n, m] expert-sorted rows with
+        ``group_sizes`` [e] segment lengths -> [n, m]. Each projection
+        is one ``grouped_matmul`` (Pallas kernel on TPU, ragged_dot
+        fallback elsewhere); int8-quantized experts dequantize
+        in-kernel via their per-channel scales."""
+        g = F.grouped_matmul(x_sorted, self.w_gate, group_sizes,
+                             self.w_gate_scale, impl=impl)
+        u = F.grouped_matmul(x_sorted, self.w_up, group_sizes,
+                             self.w_up_scale, impl=impl)
+        h = F.swiglu(g, u)
+        return F.grouped_matmul(h, self.w_down, group_sizes,
+                                self.w_down_scale, impl=impl)
 
 
 class MoELayer(Layer):
@@ -152,8 +183,14 @@ class MoELayer(Layer):
     dispatch/combine all-to-alls."""
 
     def __init__(self, d_model, num_experts, d_ff=None, k=2,
-                 capacity_factor=1.25, gate=None, experts=None):
+                 capacity_factor=1.25, gate=None, experts=None,
+                 impl="dense"):
         super().__init__()
+        if impl not in ("dense", "ragged"):
+            raise ValueError(
+                f'MoELayer impl must be "dense" or "ragged", got '
+                f"{impl!r}"
+            )
         self.d_model = d_model
         self.num_experts = num_experts
         self.gate = gate or TopKGate(d_model, num_experts, k,
@@ -161,6 +198,25 @@ class MoELayer(Layer):
         self.experts = experts or SwiGLUExperts(
             num_experts, d_model, d_ff or 4 * d_model
         )
+        # "dense": the capacity-padded [e, c, m] grouped einsum (the
+        # bit-reference path). "ragged": dropless sort-by-expert +
+        # ragged grouped_matmul over contiguous expert segments — no
+        # capacity padding, no drops (capacity_factor is ignored), aux
+        # loss bit-identical. Requires the stock TopKGate routing and a
+        # SwiGLUExperts-compatible `forward_ragged`.
+        if impl == "ragged":
+            if gate is not None and type(gate) is not TopKGate:
+                raise ValueError(
+                    'MoELayer(impl="ragged") needs the stock TopKGate '
+                    "routing (custom gates keep the dense dispatch/"
+                    "combine contract)"
+                )
+            if not hasattr(self.experts, "forward_ragged"):
+                raise ValueError(
+                    'MoELayer(impl="ragged") needs experts exposing '
+                    "forward_ragged(x_sorted, group_sizes)"
+                )
+        self.impl = impl
 
     def forward(self, x, return_stats=False):
         """[b, s, m] -> ([b, s, m], aux_loss). With return_stats=True a
@@ -181,6 +237,25 @@ class MoELayer(Layer):
             if return_stats:
                 return F.reshape(out, [b, s, m]), aux, {}
             return F.reshape(out, [b, s, m]), aux
+        if self.impl == "ragged":
+            logits = F.matmul(flat, self.gate.weight)
+            xs, group_sizes, order, cw, _eids, aux = (
+                F.moe_ragged_dispatch(flat, logits, k=self.gate.k)
+            )
+            ys = self.experts.forward_ragged(xs, group_sizes)
+            out = F.moe_ragged_combine(ys, order, cw)
+            out = F.reshape(out, [b, s, m])
+            if return_stats:
+                # dropless by construction: the counters exist so
+                # callers can swap impls without changing their
+                # accounting
+                stats = {
+                    "dropped_assignments": 0,
+                    "total_assignments": b * s * self.gate.k,
+                    "capacity": None,
+                }
+                return out, aux, stats
+            return out, aux
         logits = F.matmul(flat, self.gate.weight)
         cap = self.gate.capacity(b * s)
         dispatched, cw, eids, slots, aux, n_drop = F.moe_gate_dispatch(

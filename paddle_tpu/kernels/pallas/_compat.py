@@ -1,12 +1,31 @@
-"""jax version compatibility for the Pallas TPU kernels.
+"""Shared runtime for the Pallas TPU kernels: jax compat + dispatch.
 
-jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams``;
-resolve whichever this jax exposes once, and fail loudly at import time
-(not at first kernel call) if neither exists.
+Three concerns every kernel in this package routes through, instead of
+per-file version sniffing and ad-hoc interpret checks:
+
+  * ``CompilerParams`` — jax renamed ``pltpu.TPUCompilerParams`` to
+    ``pltpu.CompilerParams``; resolve whichever this jax exposes once,
+    and fail loudly at import time (not at first kernel call) if
+    neither exists. Audited against the current pin (jax 0.4.37 ships
+    ``TPUCompilerParams``; newer jax ships ``CompilerParams``).
+  * ``pl_call()`` — the one ``pl.pallas_call`` wrapper: interpret-mode
+    autoselect off-TPU (so CPU tier-1 exercises the same kernel code
+    path the TPU compiles) and ``dimension_semantics`` threading
+    through the resolved CompilerParams class.
+  * ``record_fallback()`` — kernel-path observability: every time a
+    Pallas hot path degrades to its XLA fallback (unsupported backend,
+    shape, or dtype) the degradation is counted in
+    ``paddle_tpu_kernels_fallbacks_total{kernel,reason}`` and warned
+    once per (kernel, reason). Degradation never raises; the counter is
+    best-effort (a broken metrics registry must not take down a
+    launch).
 """
 from __future__ import annotations
 
+import warnings
+
 import jax
+from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 CompilerParams = getattr(
@@ -18,3 +37,81 @@ if CompilerParams is None:  # pragma: no cover - future-jax guard
         "TPUCompilerParams exists; update kernels/pallas/_compat.py for "
         "this jax version"
     )
+
+
+def interpret_mode():
+    """True off-TPU: kernels run under the Pallas interpreter so the
+    same kernel body is testable on the CPU mesh."""
+    return jax.default_backend() != "tpu"
+
+
+def pl_call(kernel, *, dimension_semantics=None, interpret=None,
+            compiler_params=None, **kwargs):
+    """``pl.pallas_call`` with the package-wide defaults applied:
+    interpret-mode autoselect (``interpret=None``) and
+    ``dimension_semantics`` routed through the version-resolved
+    CompilerParams class. Any explicit ``compiler_params`` wins."""
+    if compiler_params is None and dimension_semantics is not None:
+        compiler_params = CompilerParams(
+            dimension_semantics=tuple(dimension_semantics)
+        )
+    if interpret is None:
+        interpret = interpret_mode()
+    return pl.pallas_call(
+        kernel, compiler_params=compiler_params, interpret=interpret,
+        **kwargs,
+    )
+
+
+# (kernel, reason) pairs already warned about — the counter moves on
+# every degradation, the warning fires once per pair per process
+_warned_fallbacks = set()
+
+
+def record_fallback(kernel, reason, hint=None):
+    """A Pallas path degraded to its XLA fallback. Count it (always)
+    and warn (once per (kernel, reason)); NEVER raise — degradation is
+    the contract, the fallback produces the same math. ``hint`` lets
+    the caller append remediation that actually applies to ITS
+    degradation (e.g. the interpret flag for an off-backend serving
+    request)."""
+    try:
+        from ...observability import counter
+
+        counter(
+            "paddle_tpu_kernels_fallbacks_total",
+            "Pallas kernel launches degraded to the XLA fallback",
+            labelnames=("kernel", "reason"),
+        ).inc(kernel=kernel, reason=reason)
+    except Exception:
+        # analysis: allow(broad-except) fallback telemetry is
+        # best-effort: a broken metrics registry must not take down the
+        # launch that is already degrading gracefully
+        pass
+    if (kernel, reason) not in _warned_fallbacks:
+        _warned_fallbacks.add((kernel, reason))
+        msg = (
+            f"pallas kernel {kernel!r} degraded to the XLA fallback "
+            f"({reason})"
+        )
+        if hint:
+            msg += f"; {hint}"
+        warnings.warn(msg, stacklevel=3)
+
+
+def fallbacks_total():
+    """Current total of the degradation counter (test/diagnostic
+    accessor); 0 when the registry is unavailable."""
+    try:
+        from ...observability import counter
+
+        c = counter(
+            "paddle_tpu_kernels_fallbacks_total",
+            "Pallas kernel launches degraded to the XLA fallback",
+            labelnames=("kernel", "reason"),
+        )
+        return sum(child.value for _, child in c._series())
+    except Exception:
+        # analysis: allow(broad-except) same best-effort contract as
+        # record_fallback above
+        return 0

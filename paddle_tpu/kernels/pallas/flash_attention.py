@@ -26,15 +26,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._compat import CompilerParams as _CompilerParams
+from ._compat import pl_call
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
-
-
-def _interpret():
-    return jax.default_backend() != "tpu"
 
 
 # ---------------------------------------------------------------- forward
@@ -109,7 +105,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     block_k = min(block_k, sk)
     grid = (bh, pl.cdiv(sq, block_q), pl.cdiv(sk, block_k))
 
-    out, lse = pl.pallas_call(
+    out, lse = pl_call(
         functools.partial(
             _fwd_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, seq_k=sk,
@@ -133,10 +129,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-        interpret=_interpret(),
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
     )(q, k, v)
     return out, lse
 
@@ -267,7 +260,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
     # sublane-replicated like lse (TPU block tiling rule)
     delta = jnp.broadcast_to(delta_row[:, None, :], (bh, 8, sq))
 
-    dq = pl.pallas_call(
+    dq = pl_call(
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
@@ -284,13 +277,10 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-        interpret=_interpret(),
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
     )(q, k, v, do, lse, delta)
 
-    dk, dv = pl.pallas_call(
+    dk, dv = pl_call(
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k,
@@ -316,10 +306,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_k):
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-        interpret=_interpret(),
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
 

@@ -22,8 +22,18 @@ Layout:
                physical page block_tables[b, i])
   lengths      [batch] int32  (tokens currently in the cache per sequence)
 
-On non-TPU backends the kernel runs in interpreter mode so numerics are
-testable on the CPU mesh (same policy as flash_attention.py).
+Quantized (int8) pages: ``k_pages``/``v_pages`` may instead be a
+``(pages int8, scales float32 [num_kv_heads, num_pages, page_size])``
+pair — one scale per cached token per kv head (quantize-on-write, see
+``update_pages``); both the Pallas kernel and the XLA reference
+dequantize in-attention (``k = int8 * scale``), so the int8 cache never
+materializes a dense float copy.
+
+A sequence with ``lengths[b] == 0`` returns exact zeros (nothing to
+attend over) on BOTH paths — serving's inactive-slot convention.
+
+On non-TPU backends the kernel runs under the Pallas interpreter
+(``_compat.pl_call``) so numerics are testable on the CPU mesh.
 """
 from __future__ import annotations
 
@@ -34,13 +44,30 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._compat import CompilerParams as _CompilerParams
+from ._compat import pl_call
 
 NEG_INF = -1e30
 
 
-def _interpret():
-    return jax.default_backend() != "tpu"
+def _split_quant(pages):
+    """(pages, scales) for a quantized pair, (pages, None) otherwise."""
+    if isinstance(pages, (tuple, list)):
+        return pages[0], pages[1]
+    return pages, None
+
+
+def quantize_tokens(kv):
+    """Per-token-per-head absmax int8 quantization of new cache entries.
+
+    kv: [..., d] float -> (q int8 [..., d], scale float32 [...]) with
+    ``kv ≈ q * scale[..., None]``. The scale floor keeps all-zero tokens
+    exact (q == 0, scale == 1e-8)."""
+    absmax = jnp.max(jnp.abs(kv.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(kv.astype(jnp.float32) / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
 
 
 def _decode_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
@@ -61,31 +88,10 @@ def _decode_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, 0].astype(jnp.float32)   # [group_pad, d]
         k = k_ref[0, 0].astype(jnp.float32)   # [page_size, d]
         v = v_ref[0, 0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [group_pad, page_size]
-
-        # mask cache slots at/after the current length (unwritten tail of
-        # the last partially-filled page)
-        pos = page * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1
+        _online_softmax_step(
+            q, k, v, m_scr, l_scr, acc_scr,
+            scale=scale, page_size=page_size, page=page, length=length,
         )
-        s = jnp.where(pos < length, s, NEG_INF)
-
-        m_prev = m_scr[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_scr[:] = jnp.broadcast_to(
-            l_scr[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True),
-            l_scr.shape,
-        )
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
 
     @pl.when(page == n_pages - 1)
     def _finalize():
@@ -94,12 +100,78 @@ def _decode_kernel(lengths_ref, tables_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
 
 
+def _decode_kernel_quant(lengths_ref, tables_ref, q_ref, k_ref, v_ref,
+                         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                         scale, page_size):
+    """Int8 variant: dequantize the page in-kernel from its per-token
+    scales before the online-softmax step."""
+    b = pl.program_id(0)
+    page = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+    length = lengths_ref[b]
+
+    @pl.when(page == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(page * page_size < length)
+    def _visit():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0][:, None]
+        v = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0][:, None]
+        _online_softmax_step(
+            q, k, v, m_scr, l_scr, acc_scr,
+            scale=scale, page_size=page_size, page=page, length=length,
+        )
+
+    @pl.when(page == n_pages - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def _online_softmax_step(q, k, v, m_scr, l_scr, acc_scr, *, scale,
+                         page_size, page, length):
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [group_pad, page_size]
+
+    # mask cache slots at/after the current length (unwritten tail of
+    # the last partially-filled page)
+    pos = page * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1
+    )
+    s = jnp.where(pos < length, s, NEG_INF)
+
+    m_prev = m_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_scr[:] = jnp.broadcast_to(
+        l_scr[:, :1] * alpha + jnp.sum(p, axis=1, keepdims=True),
+        l_scr.shape,
+    )
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+
 def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
                     scale=None):
     """Decode-mode paged attention. Returns [batch, num_q_heads, head_dim].
 
     GQA: num_q_heads must be a multiple of num_kv_heads; query heads are
-    grouped per kv head inside the kernel."""
+    grouped per kv head inside the kernel. ``k_pages``/``v_pages`` may be
+    int8 ``(pages, scales)`` pairs (module docstring)."""
+    k_pages, k_scales = _split_quant(k_pages)
+    v_pages, v_scales = _split_quant(v_pages)
+    quant = k_scales is not None
     batch, n_q_heads, d = q.shape
     n_kv_heads, n_pages_total, page_size, _ = k_pages.shape
     pages_per_seq = block_tables.shape[1]
@@ -127,18 +199,33 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
     def kv_map(b, h, i, lens, tabs):
         return (h, tabs[b, i], 0, 0)
 
-    out = pl.pallas_call(
+    def sc_map(b, h, i, lens, tabs):
+        return (h, tabs[b, i], 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, group_pad, d), q_map),
+        pl.BlockSpec((1, 1, page_size, d), kv_map),
+        pl.BlockSpec((1, 1, page_size, d), kv_map),
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quant:
+        kernel = _decode_kernel_quant
+        in_specs += [
+            pl.BlockSpec((1, 1, page_size), sc_map),
+            pl.BlockSpec((1, 1, page_size), sc_map),
+        ]
+        operands += [k_scales, v_scales]
+    else:
+        kernel = _decode_kernel
+
+    out = pl_call(
         functools.partial(
-            _decode_kernel, scale=float(scale), page_size=page_size,
+            kernel, scale=float(scale), page_size=page_size,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, 1, group_pad, d), q_map),
-                pl.BlockSpec((1, 1, page_size, d), kv_map),
-                pl.BlockSpec((1, 1, page_size, d), kv_map),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, group_pad, d), q_map),
             scratch_shapes=[
                 pltpu.VMEM((group_pad, 128), jnp.float32),
@@ -149,12 +236,9 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
         out_shape=jax.ShapeDtypeStruct(
             (batch, n_kv_heads, group_pad, d), q.dtype
         ),
-        compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-        interpret=_interpret(),
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
     )(lengths.astype(jnp.int32), block_tables.astype(jnp.int32),
-      qg, k_pages, v_pages)
+      *operands)
 
     return out[:, :, :group, :].reshape(batch, n_q_heads, d)
 
@@ -163,7 +247,10 @@ def paged_attention_xla(q, k_pages, v_pages, block_tables, lengths, *,
                         scale=None):
     """Pure-XLA reference of the same contract (gather + masked softmax).
     Used by tests as the numeric oracle and as the fallback when the
-    Pallas path is disabled."""
+    Pallas path is disabled. Accepts the same int8 ``(pages, scales)``
+    pairs (dequantized after the gather, before the softmax)."""
+    k_pages, k_scales = _split_quant(k_pages)
+    v_pages, v_scales = _split_quant(v_pages)
     batch, n_q_heads, d = q.shape
     n_kv_heads, _, page_size, _ = k_pages.shape
     pages_per_seq = block_tables.shape[1]
@@ -176,6 +263,15 @@ def paged_attention_xla(q, k_pages, v_pages, block_tables, lengths, *,
     v = jnp.swapaxes(v_pages[:, block_tables], 0, 1)
     k = k.reshape(batch, n_kv_heads, pages_per_seq * page_size, d)
     v = v.reshape(batch, n_kv_heads, pages_per_seq * page_size, d)
+    if k_scales is not None:
+        ks = jnp.swapaxes(k_scales[:, block_tables], 0, 1)
+        vs = jnp.swapaxes(v_scales[:, block_tables], 0, 1)
+        k = k.astype(jnp.float32) * ks.reshape(
+            batch, n_kv_heads, -1
+        )[..., None]
+        v = v.astype(jnp.float32) * vs.reshape(
+            batch, n_kv_heads, -1
+        )[..., None]
 
     qg = q.reshape(batch, n_kv_heads, group, d).astype(jnp.float32)
     s = jnp.einsum("bhgd,bhkd->bhgk", qg, k.astype(jnp.float32)) * scale
@@ -185,6 +281,11 @@ def paged_attention_xla(q, k_pages, v_pages, block_tables, lengths, *,
     )
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhgk,bhkd->bhgd", p, v.astype(jnp.float32))
+    # a length-0 sequence has nothing to attend over: the all-masked
+    # softmax is uniform garbage, so pin the row to the Pallas kernel's
+    # exact-zero contract (serving never reads inactive slots, but the
+    # two paths must agree everywhere)
+    out = jnp.where(lengths[:, None, None, None] > 0, out, 0.0)
     return out.reshape(batch, n_q_heads, d).astype(q.dtype)
 
 
@@ -199,8 +300,14 @@ def update_pages(k_pages, v_pages, k_new, v_new, block_tables, lengths):
     scatter row is pushed out of bounds so jax drops it — because the
     gather on block_tables would otherwise clamp to the last page and
     silently overwrite live cache slots; the caller owns capacity policy
-    (grow the block table or evict), as in the reference's serving loop."""
-    page_size = k_pages.shape[2]
+    (grow the block table or evict), as in the reference's serving loop.
+
+    With int8 ``(pages, scales)`` pairs the token is quantized on write
+    (``quantize_tokens``) and its scale lands in the same slot of the
+    scale plane; the page write and the scale write share one routing."""
+    kq, k_scales = _split_quant(k_pages)
+    vq, v_scales = _split_quant(v_pages)
+    page_size = kq.shape[2]
     capacity = block_tables.shape[1] * page_size
     logical_page = jnp.minimum(
         lengths // page_size, block_tables.shape[1] - 1
@@ -210,10 +317,10 @@ def update_pages(k_pages, v_pages, k_new, v_new, block_tables, lengths):
         block_tables, logical_page[:, None], axis=1
     )[:, 0]  # [batch]
     # at-capacity rows: point at a nonexistent page so the scatter drops
-    phys = jnp.where(lengths < capacity, phys, k_pages.shape[1])
+    phys = jnp.where(lengths < capacity, phys, kq.shape[1])
 
     # scatter indices: for each (batch, kv_head) write [phys, head, slot]
-    n_kv = k_pages.shape[0]
+    n_kv = kq.shape[0]
     heads = jnp.arange(n_kv)
     idx = jnp.stack(
         [
@@ -225,10 +332,18 @@ def update_pages(k_pages, v_pages, k_new, v_new, block_tables, lengths):
     ).reshape(-1, 3)  # [batch*n_kv, 3]
     k_upd = k_new.reshape(-1, k_new.shape[-1])  # batch-major over kv heads
     v_upd = v_new.reshape(-1, v_new.shape[-1])
-    k_pages = k_pages.at[idx[:, 0], idx[:, 1], idx[:, 2]].set(
-        k_upd.astype(k_pages.dtype)
-    )
-    v_pages = v_pages.at[idx[:, 0], idx[:, 1], idx[:, 2]].set(
-        v_upd.astype(v_pages.dtype)
-    )
-    return k_pages, v_pages
+    if k_scales is None:
+        kq = kq.at[idx[:, 0], idx[:, 1], idx[:, 2]].set(
+            k_upd.astype(kq.dtype)
+        )
+        vq = vq.at[idx[:, 0], idx[:, 1], idx[:, 2]].set(
+            v_upd.astype(vq.dtype)
+        )
+        return kq, vq
+    k_q8, k_s = quantize_tokens(k_upd)
+    v_q8, v_s = quantize_tokens(v_upd)
+    kq = kq.at[idx[:, 0], idx[:, 1], idx[:, 2]].set(k_q8)
+    vq = vq.at[idx[:, 0], idx[:, 1], idx[:, 2]].set(v_q8)
+    k_scales = k_scales.at[idx[:, 0], idx[:, 1], idx[:, 2]].set(k_s)
+    v_scales = v_scales.at[idx[:, 0], idx[:, 1], idx[:, 2]].set(v_s)
+    return (kq, k_scales), (vq, v_scales)

@@ -99,6 +99,88 @@ def moe_gate_dispatch(x, gate_logits, *, k=2, capacity=0,
             slots, aux, n_dropped)
 
 
+def moe_ragged_dispatch(x, gate_logits, *, k=2, renormalize=True):
+    """Dropless sort-by-expert dispatch for the ragged grouped GEMM.
+
+    The megablocks-style counterpart of :func:`moe_gate_dispatch`: the
+    same top-k + stable composite-key sort, but instead of scattering
+    into a capacity-padded [e, c, m] buffer the tokens are gathered in
+    expert-sorted order — each expert's rows form one CONTIGUOUS
+    segment, sized by ``group_sizes`` — so the expert FFN runs as a
+    ragged ``grouped_matmul`` with zero capacity padding and zero
+    drops.
+
+    x: [s, m] tokens; gate_logits: [s, e].
+    Returns (x_sorted [s*k, m], group_sizes [e] int32, order [s*k]
+    int32 (sorted row r holds assignment ``order[r]`` = token
+    ``order[r]//k`` choice ``order[r]%k``), combine_weights [s, k],
+    expert_ids [s, k] int32, aux_loss scalar).
+
+    The gate math (softmax, top-k, renormalization, aux loss) is the
+    exact expression sequence of ``moe_gate_dispatch`` with nothing
+    dropped, so the aux loss is bit-identical to the dense path and the
+    combine weights match it whenever the dense capacity drops nothing.
+    """
+    s, m = x.shape
+    e = gate_logits.shape[-1]
+    gates = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    vals, idx = jax.lax.top_k(gates, k)               # [s, k]
+
+    flat_e = idx.reshape(-1).astype(jnp.int32)        # [s*k]
+    # the same composite (expert, choice_rank, token) ordering as
+    # moe_gate_dispatch: within an expert, first choices before second
+    # choices, ties by token — rank is irrelevant to dropless math but
+    # keeps the two paths' segment layouts interchangeable
+    ar = jnp.arange(s * k, dtype=jnp.int32)
+    if e * (s * k) >= 2 ** 31:
+        rank2 = (ar % k) * s + ar // k
+        pre = jnp.argsort(rank2)
+        order = pre[jnp.argsort(flat_e[pre], stable=True)]
+    else:
+        composite = flat_e * (s * k) + (ar % k) * s + ar // k
+        order = jnp.argsort(composite)
+    order = order.astype(jnp.int32)
+    group_sizes = jnp.zeros((e,), jnp.int32).at[flat_e].add(1)
+    x_sorted = x[order // k]                          # [s*k, m]
+
+    # dropless renormalization == the dense contract with nothing
+    # dropped (same expression, same epsilon)
+    if renormalize:
+        vals = vals / (vals.sum(-1, keepdims=True) + 1e-9)
+
+    # GShard aux — identical expression to moe_gate_dispatch
+    me = gates.mean(0)                                # [e]
+    ce = jnp.zeros((e,), jnp.float32).at[idx[:, 0]].add(1.0 / s)
+    aux = jnp.sum(me * ce) * float(e)
+    return (x_sorted, group_sizes, order, vals.astype(x.dtype),
+            idx.astype(jnp.int32), aux)
+
+
+def moe_ragged_combine(y_sorted, order, combine_weights):
+    """Inverse of moe_ragged_dispatch: weight each expert-sorted row by
+    its assignment's combine weight and scatter-add back per token.
+
+    y_sorted: [s*k, m]; order: [s*k] int32; combine_weights: [s, k].
+    Returns [s, m]."""
+    sk, m = y_sorted.shape
+    s, k = combine_weights.shape
+    w = combine_weights.reshape(-1)[order]            # weight per row
+    weighted = y_sorted * w[:, None].astype(y_sorted.dtype)
+    return jnp.zeros((s, m), y_sorted.dtype).at[order // k].add(weighted)
+
+
+def grouped_matmul(lhs, rhs, group_sizes, rhs_scales=None, *,
+                   impl="auto"):
+    """Ragged grouped GEMM over contiguous expert segments — the public
+    op face of ``kernels.pallas.grouped_matmul`` (Pallas kernel on TPU,
+    ``jax.lax.ragged_dot`` fallback elsewhere; int8 ``rhs`` with
+    per-channel ``rhs_scales`` dequantizes in-kernel). Pallas imports
+    stay function-scoped (the nn_ops pattern)."""
+    from ...kernels.pallas.grouped_matmul import grouped_matmul as _gmm
+
+    return _gmm(lhs, rhs, group_sizes, rhs_scales=rhs_scales, impl=impl)
+
+
 def moe_combine(expert_out, combine_weights, expert_ids, slots):
     """Inverse of moe_gate_dispatch: gather each assignment's expert
     output and weight it; dropped assignments (slot -1) contribute 0.

@@ -1011,6 +1011,9 @@ def sequence_mask(lengths, *, maxlen=None, dtype="int64"):
     import numpy as _np
 
     if maxlen is None:
+        # deliberate graph break: the mask width is a SHAPE, so it must
+        # be concrete — callers staging this op pass maxlen explicitly
+        # analysis: allow(host-sync-in-traced) dynamic-shape graph break
         maxlen = int(_np.asarray(jax.device_get(lengths)).max())
     pos = jnp.arange(maxlen)
     mask = pos[None, :] < lengths.reshape(-1, 1)

@@ -104,6 +104,8 @@ def binomial(count, prob, *, key):
     # vary per element via masking
     import numpy as _np
 
+    # deliberate graph break: the draw count bounds a SHAPE
+    # analysis: allow(host-sync-in-traced) static Bernoulli-sum width
     nmax = int(_np.asarray(jax.device_get(n)).max()) if n.size else 0
     draws = jax.random.uniform(key, (max(nmax, 1),) + tuple(n.shape))
     mask = jnp.arange(max(nmax, 1))[(...,) + (None,) * n.ndim] < n

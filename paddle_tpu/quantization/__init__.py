@@ -18,6 +18,7 @@ __all__ = [
     "quant_dequant",
     "PerChannelAbsmaxObserver", "EMAObserver",
     "weight_quantize", "weight_dequantize", "quantize_weights",
+    "weight_quantize_grouped", "quantize_moe_experts",
 ]
 
 
@@ -325,6 +326,71 @@ def weight_dequantize(q, scale, quant_axis=-1):
     shape = [1] * qa.ndim
     shape[axis] = qa.shape[axis]
     return Tensor(qa.astype(jnp.float32) * sa.reshape(shape))
+
+
+def weight_quantize_grouped(w, bits=8):
+    """Per-expert, per-output-channel int8 quantization of stacked MoE
+    expert weights ``[e, k, f]``: one scale per (expert, output channel)
+    — absmax over the contraction axis — so each expert's quantization
+    error is independent of its siblings' weight ranges. Returns
+    (int8 weights [e, k, f], fp32 scales [e, f]) with
+    ``w ≈ q * scales[:, None, :]`` (the same scale convention as
+    :func:`weight_quantize`)."""
+    import jax.numpy as jnp
+
+    arr = w._data if isinstance(w, Tensor) else jnp.asarray(w)
+    if arr.ndim != 3:
+        raise ValueError(
+            f"weight_quantize_grouped expects stacked [e, k, f] expert "
+            f"weights, got shape {tuple(arr.shape)}"
+        )
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(arr), axis=1, keepdims=True), 1e-8
+    )  # [e, 1, f]
+    q = jnp.clip(jnp.round(arr / scale * qmax), -qmax, qmax).astype(
+        jnp.int8
+    )
+    return Tensor(q), Tensor(scale[:, 0, :] / qmax)
+
+
+def quantize_moe_experts(model, bits=8):
+    """Weight-only int8 deployment conversion for MoE expert FFNs (the
+    serving memory win for the widest weights in an MoE model): every
+    ``incubate.SwiGLUExperts`` under ``model`` has its three stacked
+    projections replaced IN PLACE by int8 weights plus per-channel fp32
+    scales (``weight_quantize_grouped``). The quantized experts run
+    only through ``MoELayer(impl="ragged")``, where ``grouped_matmul``
+    dequantizes in-kernel — no dense float copy is ever rebuilt.
+    Inference-only: quantized weights are marked stop_gradient. The
+    scales are registered as buffers, so ``state_dict()`` of a
+    quantized model carries them next to the int8 weights — quantize
+    the target model BEFORE loading such a state_dict (the structural
+    conversion, like QAT wrapping, is not re-derived from the dict).
+
+    Returns {sublayer_name: bytes_saved}.
+    """
+    from ..incubate.moe import SwiGLUExperts
+
+    out = {}
+    for name, sub in model.named_sublayers(include_self=True):
+        if not isinstance(sub, SwiGLUExperts) or sub.quantized:
+            continue
+        saved = 0
+        for wn in ("w_gate", "w_up", "w_down"):
+            w = getattr(sub, wn)
+            q, s = weight_quantize_grouped(w, bits=bits)
+            before = w._data.size * w._data.dtype.itemsize
+            w._rebind(q._data)
+            w.stop_gradient = True
+            s.stop_gradient = True
+            sub.register_buffer(wn + "_scale", s)
+            saved += before - (
+                q._data.size * q._data.dtype.itemsize
+                + s._data.size * s._data.dtype.itemsize
+            )
+        out[name or "root"] = saved
+    return out
 
 
 def quantize_weights(model, bits=8, layer_types=("Linear",)):

@@ -20,7 +20,22 @@ donate them:
 precedent of re-owning the model's weights as raw arrays and rebuilding
 the block in jnp + ops.impl functions (the same math the Tensor ops
 dispatch to, so serving numerics match ``generate``'s). Decode attention
-uses the Pallas paged kernel on TPU and the XLA reference path elsewhere.
+is selected by the adapter's ``decode_kernel`` attribute
+(``EngineConfig(decode_kernel=)`` sets it): ``"auto"`` uses the Pallas
+paged kernel on TPU and the XLA reference path elsewhere; ``"pallas"``
+requests the kernel and DEGRADES to the XLA fallback — warned and
+counted in ``paddle_tpu_kernels_fallbacks_total``, never fatal — when
+the backend/shape/dtype cannot honor it (``FLAGS_pallas_interpret``
+forces the interpreted kernel off-TPU for parity testing); ``"xla"``
+pins the fallback.
+
+Quantized KV (``EngineConfig(kv_cache_dtype="int8")``): every per-layer
+pool entry is an int8 ``(pages, scales)`` pair. All page writes
+quantize-on-write (per-token-per-head absmax, the scale landing in the
+same slot of the scale plane) and every read path dequantizes
+in-attention — the paged kernel from its scale operands, the gather
+paths right after the gather. Nothing else changes shape: the same
+routing drives both layouts.
 
 Any object exposing the same five attributes and two methods (see
 ``required_attrs``) can serve — the engine duck-types, it never imports a
@@ -55,18 +70,66 @@ required_attrs = (
 )
 
 
-def _paged_attn(q, kp, vp, block_tables, lengths):
+def _split_pages(pages):
+    """(pages, scales) for an int8-quantized per-layer entry,
+    (pages, None) for a plain float one."""
+    if isinstance(pages, (tuple, list)):
+        return pages[0], pages[1]
+    return pages, None
+
+
+def _paged_attn(q, kp, vp, block_tables, lengths, kernel="auto"):
     # pallas imports stay function-scoped (the nn_ops.py pattern): plain
     # `import paddle_tpu` must not load — nor fail on — the TPU kernel
     # stack; these run at trace time only
     from ..core import flags
+    from ..kernels.pallas._compat import record_fallback
     from ..kernels.pallas.paged_attention import (
         paged_attention,
         paged_attention_xla,
     )
 
-    if (jax.default_backend() == "tpu"
-            and flags.get_flag("FLAGS_use_pallas_kernels")):
+    on_tpu = jax.default_backend() == "tpu"
+    if kernel == "pallas":
+        # explicit request: off-TPU it degrades (warn + count) unless
+        # FLAGS_pallas_interpret pins the interpreted kernel (tests)
+        use_pallas = on_tpu or bool(
+            flags.get_flag("FLAGS_pallas_interpret")
+        )
+        if not use_pallas:
+            record_fallback(
+                "paged_attention", "backend",
+                hint="set FLAGS_pallas_interpret to run the kernel "
+                     "under the Pallas interpreter off-TPU instead",
+            )
+    elif kernel == "auto":
+        use_pallas = on_tpu and flags.get_flag("FLAGS_use_pallas_kernels")
+    elif kernel == "xla":
+        use_pallas = False
+    else:
+        raise ValueError(
+            f'decode_kernel must be "auto", "pallas" or "xla", got '
+            f"{kernel!r}"
+        )
+    if use_pallas and on_tpu:
+        # real-TPU tiling constraints: degrade, never raise (the
+        # fallback computes the same math). Pages tile at
+        # (sublane, 128) with the sublane minimum set by the pool
+        # dtype — f32 8, bf16 16, int8 32.
+        pages, scales = _split_pages(kp)
+        min_sublane = {
+            jnp.dtype(jnp.float32): 8,
+            jnp.dtype(jnp.bfloat16): 16,
+            jnp.dtype(jnp.int8): 32,
+        }.get(jnp.dtype(pages.dtype))
+        if (q.dtype not in (jnp.float32, jnp.bfloat16)
+                or min_sublane is None):
+            record_fallback("paged_attention", "dtype")
+            use_pallas = False
+        elif pages.shape[2] % min_sublane or q.shape[-1] % 128:
+            record_fallback("paged_attention", "shape")
+            use_pallas = False
+    if use_pallas:
         return paged_attention(q, kp, vp, block_tables, lengths)
     return paged_attention_xla(q, kp, vp, block_tables, lengths)
 
@@ -85,17 +148,29 @@ def _write_chunk_pages(pages, kv, block_table, length, cache_len):
     """``_write_prompt_pages`` with a position offset: chunk token t
     lands at GLOBAL position ``cache_len + t`` (chunked prefill / cached
     prefix continuation). Padded tail positions route out of bounds; the
-    block-table gather clamps for them, then the write is dropped."""
-    n_blocks = pages.shape[1]
-    block_size = pages.shape[2]
+    block-table gather clamps for them, then the write is dropped.
+
+    Int8 pools quantize-on-write: the token's per-head scale is
+    scattered into the scale plane with the same routing (dropped
+    together with its page write)."""
+    buf, scales = _split_pages(pages)
+    n_blocks = buf.shape[1]
+    block_size = buf.shape[2]
     s = kv.shape[0]
     t = jnp.arange(s)
     gpos = cache_len + t
     phys = jnp.where(t < length, block_table[gpos // block_size], n_blocks)
     slot = gpos % block_size
-    return pages.at[:, phys, slot].set(
-        jnp.swapaxes(kv, 0, 1).astype(pages.dtype)
-    )
+    if scales is None:
+        return buf.at[:, phys, slot].set(
+            jnp.swapaxes(kv, 0, 1).astype(buf.dtype)
+        )
+    from ..kernels.pallas.paged_attention import quantize_tokens
+
+    q8, sc = quantize_tokens(kv)           # [S, kvh, d], [S, kvh]
+    buf = buf.at[:, phys, slot].set(jnp.swapaxes(q8, 0, 1))
+    scales = scales.at[:, phys, slot].set(jnp.swapaxes(sc, 0, 1))
+    return (buf, scales)
 
 
 def _write_window_pages(pages, kv, phys, slot):
@@ -104,8 +179,16 @@ def _write_window_pages(pages, kv, phys, slot):
     coordinates ``phys``/``slot`` [slots, S] (invalid positions carry
     ``phys == num_blocks`` so the scatter drops them — the same
     out-of-bounds routing every other page write uses)."""
-    vals = jnp.moveaxis(kv, 2, 0).astype(pages.dtype)  # [kv, slots, S, d]
-    return pages.at[:, phys, slot].set(vals)
+    buf, scales = _split_pages(pages)
+    if scales is None:
+        vals = jnp.moveaxis(kv, 2, 0).astype(buf.dtype)  # [kv,slots,S,d]
+        return buf.at[:, phys, slot].set(vals)
+    from ..kernels.pallas.paged_attention import quantize_tokens
+
+    q8, sc = quantize_tokens(kv)           # [slots,S,kvh,d], [slots,S,kvh]
+    buf = buf.at[:, phys, slot].set(jnp.moveaxis(q8, 2, 0))
+    scales = scales.at[:, phys, slot].set(jnp.moveaxis(sc, 2, 0))
+    return (buf, scales)
 
 
 def _gather_context_batch(pages, block_tables):
@@ -113,7 +196,11 @@ def _gather_context_batch(pages, block_tables):
     [slots, P] gathers to ``[slots, P*bs, kv_heads, d]`` — slot s's
     logical KV timeline, position p at row p. Same layout, same
     reduction order as the single-sequence gather, just batched."""
-    g = pages[:, block_tables]             # [kv, slots, P, bs, d]
+    buf, scales = _split_pages(pages)
+    g = buf[:, block_tables]               # [kv, slots, P, bs, d]
+    if scales is not None:
+        sc = scales[:, block_tables]       # [kv, slots, P, bs]
+        g = g.astype(jnp.float32) * sc[..., None]
     g = jnp.moveaxis(g, 0, 3)              # [slots, P, bs, kv, d]
     return g.reshape(g.shape[0], -1, g.shape[3], g.shape[4])
 
@@ -127,10 +214,24 @@ def _gather_context(pages, block_table):
     (and ``generate``'s cached branch) uses, which keeps chunked and
     prefix-cached prefill BIT-identical to the one-shot program (the
     paged-einsum form of ``paged_attention_xla`` reduces in a different
-    order and drifts by ~1 ulp — enough to flip a greedy argmax)."""
-    g = pages[:, block_table]              # [kv, P, bs, d]
+    order and drifts by ~1 ulp — enough to flip a greedy argmax). An
+    int8 pool dequantizes right after the gather — the byte-parity
+    contract then becomes the documented int8 tolerance contract
+    (docs/serving.md)."""
+    buf, scales = _split_pages(pages)
+    g = buf[:, block_table]                # [kv, P, bs, d]
+    if scales is not None:
+        sc = scales[:, block_table]        # [kv, P, bs]
+        g = g.astype(jnp.float32) * sc[..., None]
     g = jnp.moveaxis(g, 0, 2)              # [P, bs, kv, d]
     return g.reshape(-1, g.shape[2], g.shape[3])
+
+
+def _pages_geometry(entry):
+    """(num_blocks, block_size) of one per-layer pool entry (plain
+    array or int8 (pages, scales) pair)."""
+    buf, _ = _split_pages(entry)
+    return buf.shape[1], buf.shape[2]
 
 
 class LlamaServingAdapter:
@@ -140,6 +241,10 @@ class LlamaServingAdapter:
     call ``refresh()`` after a weight swap). Tied embeddings resolve the
     LM head to ``embed.T`` inside the staged program.
     """
+
+    # decode attention path: "auto" | "pallas" | "xla" (module
+    # docstring); the engine sets this from EngineConfig(decode_kernel=)
+    decode_kernel = "auto"
 
     def __init__(self, model):
         cfg = model.config
@@ -251,7 +356,7 @@ class LlamaServingAdapter:
         x = w["embed"][ids][None]                       # [1, S, hid]
         pos = (cache_len + jnp.arange(s, dtype=jnp.int32))[None]
         kp, vp = list(kp), list(vp)
-        capacity = block_table.shape[0] * kp[0].shape[2]
+        capacity = block_table.shape[0] * _pages_geometry(kp[0])[1]
         # keep[q, c]: context position c visible to chunk token q
         # (causal over the global timeline; unwritten/garbage rows fall
         # outside it and contribute exact zeros after the softmax)
@@ -289,7 +394,7 @@ class LlamaServingAdapter:
         from ..kernels.pallas.paged_attention import update_pages
 
         b = tokens.shape[0]
-        capacity = block_tables.shape[1] * kp[0].shape[2]
+        capacity = block_tables.shape[1] * _pages_geometry(kp[0])[1]
         # inactive slots: write position at capacity -> update_pages drops
         write_pos = jnp.where(active, positions, capacity)
         lengths = positions + 1   # the new token attends to itself
@@ -304,7 +409,8 @@ class LlamaServingAdapter:
                 kp[li], vp[li], k[:, 0], v[:, 0], block_tables, write_pos
             )
             attn = _paged_attn(
-                q[:, 0], kp[li], vp[li], block_tables, lengths
+                q[:, 0], kp[li], vp[li], block_tables, lengths,
+                kernel=self.decode_kernel,
             )                                          # [slots, heads, d]
             x = x + attn.reshape(b, -1) @ wl["wo"]
             x = self._mlp(wl, x)
@@ -337,8 +443,7 @@ class LlamaServingAdapter:
         later launch stops at the query's own position, and a later
         write at the same position overwrites it."""
         b, s = tokens.shape
-        n_blocks = kp[0].shape[1]
-        bs_pg = kp[0].shape[2]
+        n_blocks, bs_pg = _pages_geometry(kp[0])
         capacity = block_tables.shape[1] * bs_pg
         offs = jnp.arange(s, dtype=jnp.int32)[None]        # [1, S]
         pos = positions[:, None] + offs                    # [slots, S]

@@ -142,7 +142,8 @@ class EngineConfig:
                  compile_cache=None, enable_prefix_cache=False,
                  prefix_cache_blocks=None, prefill_chunk_tokens=None,
                  max_prefill_chunks_per_step=1, speculate_tokens=None,
-                 speculate_ngram=3):
+                 speculate_ngram=3, decode_kernel="auto",
+                 kv_cache_dtype=None):
         if max_batch_slots < 1:
             raise ValueError("max_batch_slots must be >= 1")
         if page_size < 1 or max_model_len < 2:
@@ -269,6 +270,30 @@ class EngineConfig:
             )
         # longest trailing n-gram the prompt-lookup drafter matches on
         self.speculate_ngram = int(speculate_ngram)
+        # decode attention path (kernels/pallas/paged_attention):
+        # "auto" keeps today's selection (Pallas on TPU under
+        # FLAGS_use_pallas_kernels, XLA elsewhere); "pallas" requests
+        # the kernel — degrading to the XLA fallback with a warning and
+        # a paddle_tpu_kernels_fallbacks_total count when the backend/
+        # shape/dtype cannot honor it, never raising; "xla" pins the
+        # fallback (the byte-reference path)
+        if decode_kernel not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f'decode_kernel must be "auto", "pallas" or "xla", got '
+                f"{decode_kernel!r}"
+            )
+        self.decode_kernel = decode_kernel
+        # KV-cache quantization: None stores the adapter dtype (byte-
+        # exact contracts hold); "int8" stores quantize-on-write int8
+        # pages + per-token scales — ~4x smaller than an fp32 pool,
+        # within the documented tolerance (docs/kernels.md), byte-exact
+        # greedy contracts become tolerance contracts
+        if kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f'kv_cache_dtype must be None or "int8", got '
+                f"{kv_cache_dtype!r}"
+            )
+        self.kv_cache_dtype = kv_cache_dtype
         self.seed = int(seed)
 
 
@@ -298,7 +323,24 @@ class Engine:
         self.pool = KVPool(
             self.adapter.num_layers, self.adapter.num_kv_heads,
             cfg.num_blocks, cfg.page_size, self.adapter.head_dim, dtype,
+            quant_dtype=cfg.kv_cache_dtype,
         )
+        # decode-kernel selection lives on the adapter (the traced
+        # decode body reads it). ALWAYS assigned when the knob exists —
+        # an adapter reused across engines must not leak a previous
+        # engine's selection into this one's traced programs (whose
+        # cache signatures and health claim THIS config). A non-default
+        # request against an adapter without the knob fails HERE with
+        # the config flag named, not at first trace.
+        if hasattr(self.adapter, "decode_kernel"):
+            self.adapter.decode_kernel = cfg.decode_kernel
+        elif cfg.decode_kernel != "auto":
+            raise TypeError(
+                f"{type(self.adapter).__name__} has no decode_kernel "
+                f"attribute, but EngineConfig(decode_kernel="
+                f"{cfg.decode_kernel!r}) needs an adapter that can "
+                "select its decode attention path"
+            )
         self.block_manager = BlockManager(cfg.num_blocks, cfg.page_size)
         self.prefix_cache = None
         if cfg.enable_prefix_cache:
@@ -419,12 +461,14 @@ class Engine:
 
         # copy-on-write divergence: duplicate one physical block across
         # every layer's pages (the partial shared block a cache match
-        # would otherwise write into)
+        # would otherwise write into). tree_map: an int8 pool's scale
+        # planes share the [*, blocks, ...] layout and copy the same way
         def cow_fn(kp, vp, src, dst):
             metrics.cow_compiles += 1       # traced-body compile probe
             jit_events.mark_traced()        # global compile/retrace log
-            kp = tuple(p.at[:, dst].set(p[:, src]) for p in kp)
-            vp = tuple(p.at[:, dst].set(p[:, src]) for p in vp)
+            copy = lambda p: p.at[:, dst].set(p[:, src])
+            kp = jax.tree_util.tree_map(copy, tuple(kp))
+            vp = jax.tree_util.tree_map(copy, tuple(vp))
             return kp, vp
 
         # speculative verification: score every slot's K+1-token draft
@@ -586,6 +630,7 @@ class Engine:
         # program for nothing
         sig = (
             f"{kind}:bucket={bucket}:any_sample={any_sample}:"
+            f"dk={self.config.decode_kernel}:"
             f"code={self._adapter_code_fp}:"
             + _cc_mod.signature_str(aargs)
         )
@@ -683,6 +728,7 @@ class Engine:
             + f"|chunk={cfg.prefill_chunk_tokens}"
             + f"|pfx={int(cfg.enable_prefix_cache)}"
             + f"|spec={cfg.speculate_tokens}"
+            + f"|dk={cfg.decode_kernel}|kvq={cfg.kv_cache_dtype}"
             + f"|code={self._adapter_code_fp}"
         )
         self._service_key = hashlib.sha256(svc.encode()).hexdigest()[:16]
@@ -1209,6 +1255,15 @@ class Engine:
             ],
             "queue_depth": len(self.waiting),
             "num_running": sum(r is not None for r in self.slots),
+            # kernel-path observability: which decode attention path
+            # this engine was configured with and what the KV pool
+            # stores (degradations are visible in the process-wide
+            # paddle_tpu_kernels_fallbacks_total counter)
+            "decode_kernel": cfg.decode_kernel,
+            "kv_cache_dtype": cfg.kv_cache_dtype or str(
+                self.pool._dtype
+            ),
+            "kv_bytes_per_token": self.pool.bytes_per_token(),
             "kv_utilization": util,
             "kv_active_utilization": util_active,
             "kv_reclaimable_blocks": reclaimable,

@@ -254,6 +254,29 @@ def annotated():
         return 1
     except Exception:
         pass  # analysis: allow(broad-except) fixture: reason goes here
+
+
+import jax
+
+
+def syncer(x):
+    return jax.device_get(x)
+
+
+@paddle.jit.to_static
+def traced_sync(x):
+    y = syncer(x)
+    return y.block_until_ready()
+
+
+def untraced_sync(x):
+    return jax.device_get(x)
+
+
+@paddle.jit.to_static
+def annotated_sync(x):
+    # analysis: allow(host-sync-in-traced) fixture: reason goes here
+    return jax.device_get(x)
 """
 
 
@@ -285,6 +308,20 @@ class TestAstLint:
             _src_line(_AST_BAD, "global _counter")
         ]
         assert "_counter" in gm[0].message
+
+    def test_host_sync_in_traced(self):
+        hs = [
+            f for f in self._findings()
+            if f.rule == "host-sync-in-traced"
+        ]
+        # `syncer` flagged (reachable from the traced_sync root),
+        # `.block_until_ready()` at the root flagged, the UNREACHABLE
+        # `untraced_sync` twin is not, and the annotated root is
+        # suppressed by its allow comment
+        assert {f.line for f in hs} == {
+            _src_line(_AST_BAD, "return jax.device_get(x)"),
+            _src_line(_AST_BAD, "return y.block_until_ready()"),
+        }
 
     def test_broad_except_and_allowlist(self):
         be = [f for f in self._findings() if f.rule == "broad-except"]

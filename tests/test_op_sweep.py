@@ -61,6 +61,9 @@ SKIP = {
     "rnn": "multi-gate recurrent contract; owned by test_nn_layers LSTM/GRU",
     "moe_gate_dispatch": "sort-based routing contract owned by test_sp_moe",
     "moe_combine": "owned by test_sp_moe",
+    "moe_ragged_dispatch": "ragged routing contract owned by test_sp_moe",
+    "moe_ragged_combine": "int32 order/weights contract owned by test_sp_moe",
+    "grouped_matmul": "segment contract owned by test_pallas_kernels",
     "fused_linear_cross_entropy": "chunked loss owned by test_fused_loss",
     "fused_rotary_position_embedding": "owned by test_pallas_kernels",
     "rope_qk": "owned by test_pallas_kernels",

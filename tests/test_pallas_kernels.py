@@ -151,3 +151,234 @@ class TestFlashAttention:
             assert all(p.grad is not None for p in m.parameters())
         finally:
             paddle.set_flags({"FLAGS_flash_attention_min_seq": 2048})
+
+
+# ----------------------------------------------------------------------
+# grouped_matmul: ragged grouped GEMM (interpret-mode kernel vs the
+# ragged_dot fallback vs an explicit numpy oracle)
+# ----------------------------------------------------------------------
+from paddle_tpu.kernels.pallas.grouped_matmul import (  # noqa: E402
+    grouped_matmul,
+)
+
+
+def _gmm_ref(lhs, rhs, group_sizes, scales=None):
+    w = rhs.astype(np.float64)
+    if scales is not None:
+        w = w * scales.astype(np.float64)[:, None, :]
+    out = np.zeros((lhs.shape[0], rhs.shape[2]), np.float64)
+    o = 0
+    for g, n in enumerate(group_sizes):
+        out[o:o + n] = lhs[o:o + n].astype(np.float64) @ w[g]
+        o += n
+    return out.astype(np.float32)
+
+
+class TestGroupedMatmul:
+    # ragged segment sweeps: empty experts (leading/trailing/interior),
+    # single-token segments, everything-on-one-expert
+    SWEEP = [
+        [5, 0, 11, 16],
+        [0, 0, 32, 0],
+        [1, 1, 1, 29],
+        [32, 0, 0, 0],
+        [0, 7, 1, 24],
+    ]
+
+    def _case(self, gs, seed=0, k=24, m=40):
+        rng = np.random.RandomState(seed)
+        lhs = rng.randn(sum(gs), k).astype(np.float32)
+        rhs = rng.randn(len(gs), k, m).astype(np.float32)
+        return (jnp.asarray(lhs), jnp.asarray(rhs),
+                jnp.asarray(np.array(gs, np.int32)))
+
+    @pytest.mark.parametrize("gs", SWEEP)
+    def test_interpret_kernel_matches_ref(self, gs):
+        lhs, rhs, gsa = self._case(gs)
+        out = np.asarray(grouped_matmul(lhs, rhs, gsa, impl="pallas"))
+        np.testing.assert_allclose(
+            out, _gmm_ref(np.asarray(lhs), np.asarray(rhs), gs),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    @pytest.mark.parametrize("gs", SWEEP)
+    def test_fallback_matches_kernel(self, gs):
+        lhs, rhs, gsa = self._case(gs, seed=1)
+        out_p = np.asarray(grouped_matmul(lhs, rhs, gsa, impl="pallas"))
+        out_x = np.asarray(grouped_matmul(lhs, rhs, gsa, impl="xla"))
+        np.testing.assert_allclose(out_p, out_x, rtol=1e-5, atol=1e-6)
+
+    def test_small_tile_and_row_padding(self):
+        # n not a multiple of the tile: rows pad internally, slice back
+        lhs, rhs, gsa = self._case([3, 2, 5, 1], k=12, m=10)
+        out = np.asarray(grouped_matmul(lhs, rhs, gsa, impl="pallas"))
+        np.testing.assert_allclose(
+            out, _gmm_ref(np.asarray(lhs), np.asarray(rhs), [3, 2, 5, 1]),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_gradients_match_fallback(self):
+        lhs, rhs, gsa = self._case([5, 0, 11, 16], seed=2)
+
+        def loss(impl):
+            return lambda a, b: grouped_matmul(
+                a, b, gsa, impl=impl
+            ).sum()
+
+        gp = jax.grad(loss("pallas"), argnums=(0, 1))(lhs, rhs)
+        gx = jax.grad(loss("xla"), argnums=(0, 1))(lhs, rhs)
+        for a, b in zip(gp, gx):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )
+
+    def test_int8_dequant_in_kernel(self):
+        gs = [5, 0, 11, 16]
+        lhs, rhs, gsa = self._case(gs, seed=3)
+        w = np.asarray(rhs)
+        scales = np.maximum(np.abs(w).max(axis=1), 1e-8) / 127.0
+        q = np.clip(
+            np.round(w / scales[:, None, :]), -127, 127
+        ).astype(np.int8)
+        out_p = np.asarray(grouped_matmul(
+            lhs, jnp.asarray(q), gsa, rhs_scales=jnp.asarray(scales),
+            impl="pallas",
+        ))
+        out_x = np.asarray(grouped_matmul(
+            lhs, jnp.asarray(q), gsa, rhs_scales=jnp.asarray(scales),
+            impl="xla",
+        ))
+        # the two int8 paths agree tightly; both sit within the
+        # documented quantization tolerance of the fp32 oracle
+        np.testing.assert_allclose(out_p, out_x, rtol=1e-4, atol=1e-4)
+        ref = _gmm_ref(np.asarray(lhs), w, gs)
+        err = np.abs(out_p - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.02, err
+
+    def test_jit_with_traced_group_sizes(self):
+        gs = [5, 0, 11, 16]
+        lhs, rhs, gsa = self._case(gs, seed=4)
+        f = jax.jit(lambda a, b, g: grouped_matmul(a, b, g, impl="pallas"))
+        np.testing.assert_allclose(
+            np.asarray(f(lhs, rhs, gsa)),
+            _gmm_ref(np.asarray(lhs), np.asarray(rhs), gs),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_bad_impl_rejected(self):
+        lhs, rhs, gsa = self._case([4, 4, 4, 4])
+        with pytest.raises(ValueError, match="impl"):
+            grouped_matmul(lhs, rhs, gsa, impl="cuda")
+
+
+# ----------------------------------------------------------------------
+# paged decode attention: interpret-mode kernel vs the XLA fallback,
+# fp32 and int8-quantized pools
+# ----------------------------------------------------------------------
+from paddle_tpu.kernels.pallas.paged_attention import (  # noqa: E402
+    paged_attention,
+    paged_attention_xla,
+    quantize_tokens,
+    update_pages,
+)
+
+
+class TestPagedAttention:
+    def _pool(self, seed=0, kvh=2, pages=10, bs=8, d=32):
+        rng = np.random.RandomState(seed)
+        kp = rng.randn(kvh, pages, bs, d).astype(np.float32)
+        vp = rng.randn(kvh, pages, bs, d).astype(np.float32)
+        return kp, vp
+
+    def test_parity_partial_and_zero_lengths(self):
+        # lengths sweep: length-0 slot (exact zeros), a mid-page partial
+        # last block, a page-aligned length, and full capacity
+        kp, vp = self._pool()
+        rng = np.random.RandomState(1)
+        q = rng.randn(4, 4, 32).astype(np.float32)       # GQA group=2
+        bt = rng.randint(0, 10, (4, 3)).astype(np.int32)
+        lens = np.array([0, 5, 16, 24], np.int32)
+        args = tuple(map(jnp.asarray, (q, kp, vp, bt, lens)))
+        out_p = np.asarray(paged_attention(*args))
+        out_x = np.asarray(paged_attention_xla(*args))
+        np.testing.assert_allclose(out_p, out_x, rtol=2e-5, atol=2e-5)
+        assert np.all(out_p[0] == 0.0) and np.all(out_x[0] == 0.0)
+
+    def test_block_table_reuse_after_free(self):
+        # a freed block's stale contents must be invisible to the next
+        # tenant: write seq A over pages [2, 3], then remap the same
+        # physical pages to seq B with a SHORTER length — positions past
+        # B's length hold A's stale rows and must be masked out
+        kp, vp = self._pool(seed=2)
+        q = np.random.RandomState(3).randn(1, 2, 32).astype(np.float32)
+        bt = np.array([[2, 3]], np.int32)
+        full = np.array([16], np.int32)
+        short = np.array([3], np.int32)
+        argf = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(bt), jnp.asarray(full))
+        args = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(bt), jnp.asarray(short))
+        out_full = np.asarray(paged_attention(*argf))
+        out_short = np.asarray(paged_attention(*args))
+        assert np.abs(out_full - out_short).max() > 1e-4  # mask matters
+        # oracle over only the first `short` rows of the mapped pages
+        ctx_k = kp[:, bt[0]].reshape(2, -1, 32)[:, :3]
+        ctx_v = vp[:, bt[0]].reshape(2, -1, 32)[:, :3]
+        s = np.einsum(
+            "hd,hkd->hk", q[0].astype(np.float64),
+            ctx_k.astype(np.float64),
+        ) / np.sqrt(32)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = np.einsum("hk,hkd->hd", p, ctx_v.astype(np.float64))
+        np.testing.assert_allclose(
+            out_short[0], ref.astype(np.float32), rtol=2e-5, atol=2e-5
+        )
+
+    def test_int8_pool_tolerance(self):
+        kp, vp = self._pool(seed=4)
+        rng = np.random.RandomState(5)
+        q = rng.randn(3, 2, 32).astype(np.float32)
+        bt = rng.randint(0, 10, (3, 3)).astype(np.int32)
+        lens = np.array([7, 20, 24], np.int32)
+        kq = quantize_tokens(jnp.asarray(kp))
+        vq = quantize_tokens(jnp.asarray(vp))
+        out_q = np.asarray(paged_attention(
+            jnp.asarray(q), kq, vq, jnp.asarray(bt), jnp.asarray(lens)
+        ))
+        out_qx = np.asarray(paged_attention_xla(
+            jnp.asarray(q), kq, vq, jnp.asarray(bt), jnp.asarray(lens)
+        ))
+        out_f = np.asarray(paged_attention_xla(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt), jnp.asarray(lens)
+        ))
+        # kernel and fallback dequantize identically...
+        np.testing.assert_allclose(out_q, out_qx, rtol=1e-4, atol=1e-5)
+        # ...and both sit within the documented int8 KV tolerance of
+        # the float pool (docs/kernels.md)
+        np.testing.assert_allclose(out_q, out_f, rtol=0.05, atol=0.05)
+
+    def test_int8_update_pages_roundtrip(self):
+        kp, vp = self._pool(seed=6, kvh=2, pages=4, bs=4, d=16)
+        kq = quantize_tokens(jnp.asarray(kp))
+        vq = quantize_tokens(jnp.asarray(vp))
+        rng = np.random.RandomState(7)
+        kn = rng.randn(2, 2, 16).astype(np.float32)
+        vn = rng.randn(2, 2, 16).astype(np.float32)
+        bt = np.array([[0, 1], [2, 3]], np.int32)
+        lens = np.array([5, 8], np.int32)  # seq1 at page-capacity slot 0
+        (k2, ks2), (v2, vs2) = update_pages(
+            kq, vq, jnp.asarray(kn), jnp.asarray(vn),
+            jnp.asarray(bt), jnp.asarray(lens),
+        )
+        # seq 0's token landed at page bt[0,1]=1 slot 1, within 1%
+        deq = np.asarray(k2)[:, 1, 1] * np.asarray(ks2)[:, 1, 1][:, None]
+        np.testing.assert_allclose(deq, kn[0], rtol=0.02, atol=0.02)
+        # untouched slots keep their prior quantized contents + scales
+        assert np.array_equal(
+            np.asarray(k2)[:, 3, 2], np.asarray(kq[0])[:, 3, 2]
+        )
+        assert np.array_equal(
+            np.asarray(ks2)[:, 3, 2], np.asarray(kq[1])[:, 3, 2]
+        )

@@ -1138,3 +1138,170 @@ class TestKVPoolRebind:
         )
         with pytest.raises(ValueError, match="dtype"):
             pool.rebind(wrong_dtype, pool.v)
+
+
+class TestKernelPathsAndInt8KV:
+    """EngineConfig(decode_kernel=) + EngineConfig(kv_cache_dtype=):
+    kernel-path selection with counted (never fatal) degradation, and
+    the int8 KV byte-budget/tolerance contract (docs/kernels.md)."""
+
+    PROMPTS = [[1, 2, 3, 4, 5], [7, 8, 9], [2, 4, 6, 8, 10, 12]]
+    SP = SamplingParams(max_new_tokens=6, eos_token_id=None)
+
+    def _cfg(self, **kw):
+        return EngineConfig(
+            max_batch_slots=4, max_model_len=32, page_size=4, seed=3,
+            **kw,
+        )
+
+    def test_decode_kernel_pallas_degrades_counted(self, model,
+                                                   small_engine):
+        import warnings
+
+        from paddle_tpu.kernels.pallas._compat import fallbacks_total
+
+        base = small_engine.generate(self.PROMPTS, self.SP)
+        before = fallbacks_total()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            eng = Engine(model, self._cfg(decode_kernel="pallas"))
+            outs = eng.generate(self.PROMPTS, self.SP)
+        # off-TPU the explicit pallas request degrades to the XLA
+        # fallback: same bytes out, counted + warned, never raised
+        assert [o.token_ids for o in outs] == [
+            o.token_ids for o in base
+        ]
+        assert fallbacks_total() > before
+        assert any("degraded" in str(x.message) for x in w)
+        h = eng.health()
+        assert h["decode_kernel"] == "pallas"
+        assert h["kv_cache_dtype"] == "float32"
+
+    def test_decode_kernel_interpret_parity(self, model, small_engine):
+        # FLAGS_pallas_interpret pins the interpreted kernel off-TPU:
+        # the real kernel body runs (no degradation) and greedy decode
+        # agrees with the XLA path on this model
+        from paddle_tpu.kernels.pallas._compat import fallbacks_total
+
+        base = small_engine.generate(self.PROMPTS, self.SP)
+        before = fallbacks_total()
+        paddle.set_flags({"FLAGS_pallas_interpret": True})
+        try:
+            eng = Engine(model, self._cfg(decode_kernel="pallas"))
+            outs = eng.generate(self.PROMPTS, self.SP)
+        finally:
+            paddle.set_flags({"FLAGS_pallas_interpret": False})
+        assert fallbacks_total() == before
+        assert [o.token_ids for o in outs] == [
+            o.token_ids for o in base
+        ]
+
+    def test_decode_kernel_needs_adapter_knob(self, model):
+        class Opaque:
+            """Adapter surface WITHOUT the decode_kernel knob."""
+            num_layers = num_kv_heads = head_dim = vocab_size = 1
+            weights = {}
+            import numpy as _np
+            dtype = _np.float32
+
+            def prefill(self, *a):
+                raise NotImplementedError
+
+            def decode(self, *a):
+                raise NotImplementedError
+
+        class NoKnob(Opaque):
+            __slots__ = ()  # attribute writes rejected
+
+        with pytest.raises(TypeError, match="decode_kernel"):
+            Engine(NoKnob(), self._cfg(decode_kernel="pallas"))
+        with pytest.raises(ValueError, match="decode_kernel"):
+            self._cfg(decode_kernel="cuda")
+
+    def test_int8_kv_halves_bytes_and_generates(self, model,
+                                                small_engine):
+        eng = Engine(model, self._cfg(kv_cache_dtype="int8"))
+        # byte budget: the int8 pool must store a token in at most HALF
+        # the bytes of the float pool (fp32 here: ~3.8x)
+        assert eng.pool.bytes_per_token() <= (
+            0.5 * small_engine.pool.bytes_per_token()
+        )
+        h = eng.health()
+        assert h["kv_cache_dtype"] == "int8"
+        assert h["kv_bytes_per_token"] == eng.pool.bytes_per_token()
+        outs = eng.generate(self.PROMPTS, self.SP)
+        # tolerance contract, not byte parity: generation completes to
+        # length with in-vocab tokens (docs/serving.md caveats)
+        for o in outs:
+            assert o.finish_reason == "length"
+            assert len(o.token_ids) == 6
+            assert all(
+                0 <= t < model.config.vocab_size for t in o.token_ids
+            )
+
+    def test_int8_pool_rebind_validates(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.serving import KVPool
+
+        pool = KVPool(2, 2, 4, 4, 8, quant_dtype="int8")
+        assert pool.bytes_per_token() == 2 * 2 * 2 * (8 + 4)
+        pool.rebind(pool.k, pool.v)  # identity rebind fine
+        with pytest.raises(ValueError, match="pages, scales"):
+            pool.rebind(
+                tuple(p for p, _ in pool.k), pool.v
+            )
+        bad_scale = tuple(
+            (p, jnp.zeros((2, 4, 4), "bfloat16")) for p, _ in pool.k
+        )
+        with pytest.raises(ValueError, match="dtype"):
+            pool.rebind(bad_scale, pool.v)
+        with pytest.raises(ValueError, match="quant_dtype"):
+            KVPool(2, 2, 4, 4, 8, quant_dtype="int4")
+
+    def test_mixed_workload_parity_pallas_vs_xla(self, model):
+        # the 32-request acceptance workload through a decode_kernel=
+        # "pallas" engine vs the byte-reference "xla" engine: off-TPU
+        # the pallas request degrades to the same fallback program, so
+        # the tolerance contract collapses to byte parity — what this
+        # asserts, along with the single-compile invariant holding
+        # under the new config axis
+        prompts, max_new, _ = _mixed_workload(32)
+        outs = {}
+        for dk in ("xla", "pallas"):
+            eng = Engine(model, EngineConfig(
+                max_batch_slots=4, max_model_len=32, page_size=4,
+                num_blocks=16, prefill_buckets=[16, 32],
+                decode_kernel=dk,
+            ))
+            res = eng.generate(
+                prompts,
+                [SamplingParams(max_new_tokens=k) for k in max_new],
+            )
+            outs[dk] = [o.token_ids for o in res]
+            assert eng.metrics.decode_compiles == 1
+        assert outs["pallas"] == outs["xla"]
+
+    @pytest.mark.slow
+    def test_warm_restart_zero_traces_with_kernel_flags(self, model,
+                                                        tmp_path):
+        # decode_kernel/kv_cache_dtype join the service key + program
+        # signatures: a warm restart replays the full program set with
+        # zero fresh traces and zero warm-retrace alarms
+        from paddle_tpu.observability import jit_events
+
+        cfg = dict(
+            max_batch_slots=2, max_model_len=32, page_size=4, seed=3,
+            decode_kernel="pallas", kv_cache_dtype="int8",
+            compile_cache=str(tmp_path / "cc"),
+        )
+        cold = Engine(model, EngineConfig(**cfg))
+        out1 = cold.generate(self.PROMPTS[:2], self.SP)
+        warm = Engine(model, EngineConfig(**cfg))
+        out2 = warm.generate(self.PROMPTS[:2], self.SP)
+        m = warm.metrics
+        assert (m.prefill_compiles, m.decode_compiles) == (0, 0)
+        assert [o.token_ids for o in out1] == [
+            o.token_ids for o in out2
+        ]
+        assert jit_events.retraces_after_warmup() == 0
