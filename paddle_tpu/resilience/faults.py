@@ -36,6 +36,15 @@ docs/resilience.md):
                        request_id=, replica=) — routing failures must
                        degrade to a retry on the next fleet step, never
                        to a dropped request
+    journal.append     one request-journal flush (context: path=,
+                       records=) — append failures degrade to a
+                       warning + paddle_tpu_serving_journal_* counters
+                       (records dropped, serving continues), never to
+                       a fatal
+    journal.replay     one request-journal replay at engine/fleet
+                       build (context: path=) — a replay failure
+                       degrades to an empty recovery (warn + counter),
+                       never blocks serving
     dataloader.worker  one process-worker job (context: worker_id=)
     train.step         one elastic-train-loop step, fired BEFORE the
                        step body (context: step=) — the chaos hook the

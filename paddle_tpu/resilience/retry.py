@@ -61,6 +61,15 @@ class RetryPolicy:
             d *= 1.0 + self._rng.uniform(-self.jitter, self.jitter)
         return max(0.0, d)
 
+    def pause(self, attempt):
+        """Sleep this policy's backoff for ``attempt`` (2-indexed like
+        :meth:`delay`) — for call sites that own their loop but want
+        the policy's backoff curve (e.g. the serving shed-retry loop).
+        Returns the seconds slept."""
+        d = self.delay(attempt)
+        self._sleep(d)
+        return d
+
     def call(self, fn, *args, on_retry=None, **kwargs):
         """Run ``fn(*args, **kwargs)`` under this policy. ``on_retry``
         (exc, attempt) is invoked before each backoff sleep — call sites

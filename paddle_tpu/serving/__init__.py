@@ -25,6 +25,7 @@ from .fleet import (
     FleetRequest,
     NoReplicaError,
 )
+from .journal import Journal, ReplayEntry
 from .kv_cache import BlockManager, KVPool
 from .metrics import EngineMetrics
 from .prefix_cache import PrefixCache, PrefixMatch
@@ -35,7 +36,7 @@ __all__ = [
     "Engine", "EngineConfig", "EngineOverloadedError", "SamplingParams",
     "Request", "RequestOutput", "RequestState", "BlockManager", "KVPool",
     "EngineMetrics", "LlamaServingAdapter", "build_adapter",
-    "PrefixCache", "PrefixMatch",
+    "PrefixCache", "PrefixMatch", "Journal", "ReplayEntry",
     "Fleet", "FleetConfig", "FleetMetrics", "FleetRequest",
     "NoReplicaError", "ReplicaSupervisor",
 ]

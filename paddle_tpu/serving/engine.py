@@ -143,7 +143,7 @@ class EngineConfig:
                  prefix_cache_blocks=None, prefill_chunk_tokens=None,
                  max_prefill_chunks_per_step=1, speculate_tokens=None,
                  speculate_ngram=3, decode_kernel="auto",
-                 kv_cache_dtype=None):
+                 kv_cache_dtype=None, journal=None):
         if max_batch_slots < 1:
             raise ValueError("max_batch_slots must be >= 1")
         if page_size < 1 or max_model_len < 2:
@@ -294,6 +294,15 @@ class EngineConfig:
                 f"{kv_cache_dtype!r}"
             )
         self.kv_cache_dtype = kv_cache_dtype
+        # durable request journal (serving/journal.py): a directory
+        # path or a Journal. When set, every admission/token/finish is
+        # WAL-logged and a restarting engine replays the journal
+        # BEFORE traffic — unfinished requests re-admitted at the
+        # queue head through the resume() re-prefill contract (greedy
+        # byte-identical). None (the default) keeps serving state
+        # process-local. For a Fleet use FleetConfig(journal_dir=)
+        # instead: replicas share one fleet-level journal.
+        self.journal = journal
         self.seed = int(seed)
 
 
@@ -360,7 +369,27 @@ class Engine:
         self._admit_counter = 0
         self._key_counter = 0
         self._base_key = jax.random.PRNGKey(cfg.seed)
+        # shed-retry backoff (generate()): when every pending prompt
+        # is shed and nothing is in flight, the submit loop must wait
+        # out the pressure instead of spinning on no-op step() calls
+        from ..resilience.retry import RetryPolicy
+
+        self._shed_backoff = RetryPolicy(
+            max_attempts=None, deadline=float("inf"),
+            base_delay=0.001, max_delay=0.05, jitter=0.1, seed=cfg.seed,
+        )
         self._build_steps()
+        # durable request journal: replayed AFTER the programs exist
+        # (a compile cache has already warmed every prefill bucket by
+        # now, so recovery re-prefills are zero-trace) and BEFORE any
+        # traffic. Unfinished journaled requests join the queue head.
+        self.journal = None
+        self._journal_replaying = False
+        if cfg.journal is not None:
+            from .journal import resolve_journal
+
+            self.journal = resolve_journal(cfg.journal, seed=cfg.seed)
+            self._replay_journal()
         # observability: a comm watchdog trip dumps this engine's health
         # snapshot next to the thread stacks, and the scrape endpoint's
         # /healthz aggregates the same snapshot. Registered through a
@@ -784,6 +813,46 @@ class Engine:
             fallbacks=m.fallbacks - before[2],
         )
 
+    # -- durable request journal (serving/journal.py) ------------------------
+    def _replay_journal(self):
+        """Crash recovery: fold the journal into unfinished requests
+        and re-admit them at the HEAD of the waiting queue (they have
+        been waiting longest), oldest first. Each carries its emitted
+        tokens, so the resume() re-prefill rebuilds its KV over
+        ``prompt + output[:-1]`` — greedy continuation is
+        byte-identical to an uninterrupted run and no journaled token
+        is re-emitted. Requests whose TTL lapsed while the process was
+        down are retired with ``"timeout"`` instead of re-prefilled
+        (deadline-aware recovery). The re-admissions are re-journaled
+        (ADMIT with cursor) so the dead incarnation's segments can
+        compact as soon as the recovered work drains."""
+        from .journal import restore_entries
+
+        entries = self.journal.replay()
+        if not entries:
+            self.journal.flush()
+            return
+        live, expired = restore_entries(
+            self.journal, entries,
+            lambda e, params: Request(e.prompt, params,
+                                      request_id=e.rid),
+        )
+        self.metrics.requests_timeout += expired
+        self._journal_replaying = True
+        try:
+            for req in reversed(live):
+                self.resume(req)
+        finally:
+            self._journal_replaying = False
+        for req in live:   # re-ADMIT in admission order, cursor kept
+            self.journal.admit(req)
+        self.journal.flush()
+        _flight.record(
+            "serving", "journal-recovered", engine=self.engine_id,
+            requests=len(live),
+            expired=len(entries) - len(live),
+        )
+
     def check_decode(self, mode="error"):
         """Statically analyze the decode step (``paddle_tpu.analysis``)
         over representative inputs and assert it is free of host-sync
@@ -988,6 +1057,23 @@ class Engine:
         self._key_counter += 1
         return jax.random.fold_in(self._base_key, self._key_counter)
 
+    def _request_key(self, req):
+        """PRNG key for a single-request launch (prefill / final
+        chunk). The engine stream ALWAYS advances — a seeded request in
+        the mix never shifts other requests' keys — but a sampled
+        request carrying an explicit ``SamplingParams.seed`` draws
+        ``fold_in(PRNGKey(seed), n_generated)`` instead: its first
+        token is reproducible across restarts, journal replays, and
+        failovers regardless of engine history. Batched decode keeps
+        the shared per-step stream (docs/serving.md caveat)."""
+        key = self._next_key()
+        p = req.sampling_params
+        if p.do_sample and p.seed is not None:
+            return jax.random.fold_in(
+                jax.random.PRNGKey(p.seed), len(req.output_token_ids)
+            )
+        return key
+
     # -- client API ----------------------------------------------------------
     def add_request(self, prompt_token_ids, sampling_params=None,
                     request_id=None):
@@ -1037,6 +1123,12 @@ class Engine:
                 )
         self.waiting.append(req)
         self.metrics.requests_received += 1
+        if self.journal is not None and not self._journal_replaying:
+            # WAL the admission (buffered urgent; the next step's group
+            # flush makes it durable BEFORE any of its tokens can — an
+            # admission is only actionable through step() anyway). The
+            # fleet front door flushes per admission instead.
+            self.journal.admit(req)
         return req
 
     def _active_pressure(self):
@@ -1094,6 +1186,10 @@ class Engine:
         req.state = RequestState.WAITING
         self.waiting.appendleft(req)
         self.metrics.requests_received += 1
+        if self.journal is not None and not self._journal_replaying:
+            # re-ADMIT with the emit cursor: replay must not re-count
+            # the tokens this request already produced elsewhere
+            self.journal.admit(req)
         return req
 
     def abort(self, request_id):
@@ -1130,13 +1226,16 @@ class Engine:
         cap = self.config.max_waiting
         pending = collections.deque(zip(prompts, params))
         reqs, done = [], {}
+        stalls = 0
         while pending or self.has_unfinished():
+            admitted = False
             while pending and (cap is None or len(self.waiting) < cap):
                 p, sp = pending.popleft()
                 try:
                     self._suppress_shed_events = True
                     try:
                         reqs.append(self.add_request(p, sp))
+                        admitted = True
                     finally:
                         self._suppress_shed_events = False
                 except EngineOverloadedError:
@@ -1146,8 +1245,19 @@ class Engine:
                     self.metrics.requests_shed -= 1
                     pending.appendleft((p, sp))
                     break
-            for out in self.step():
+            outs = self.step()
+            for out in outs:
                 done[out.request_id] = out
+            if (pending and not admitted and not outs
+                    and not self.has_unfinished()):
+                # every prompt shed with nothing in flight: step() is a
+                # no-op, so spinning on it burns a core without moving
+                # the pressure — back off (exponential + jitter) until
+                # admission clears
+                stalls += 1
+                self._shed_backoff.pause(stalls + 1)
+            else:
+                stalls = 0
         return [done[r.request_id] for r in reqs]
 
     # -- scheduler -----------------------------------------------------------
@@ -1199,6 +1309,14 @@ class Engine:
                 probes={f"serving.engine.{self.engine_id}": probe},
             )
             raise
+        if self.journal is not None:
+            # batched EMIT + group write (finished requests already
+            # buffered theirs in _finish). Steady-state steps are a
+            # near-no-op: tokens batch on the Request objects until
+            # the write interval elapses or a completion makes the
+            # buffer urgent — a lost interval's tokens are re-derived
+            # byte-identically by replay's recompute.
+            self.journal.step_flush(self.slots)
         m, bm = self.metrics, self.block_manager
         m.queue_depth = len(self.waiting)
         m.num_running = sum(r is not None for r in self.slots)
@@ -1429,7 +1547,7 @@ class Engine:
                     ids, np.int32(len(tokens)), table,
                     np.float32(p.temperature), np.int32(p.top_k),
                     np.float32(p.top_p), np.bool_(p.do_sample),
-                    self._next_key(),
+                    self._request_key(req),
                 )
                 if self._cc is not None:
                     # compile-cache mode: launch the AOT executable
@@ -1562,7 +1680,7 @@ class Engine:
                     table,
                     np.float32(p.temperature), np.int32(p.top_k),
                     np.float32(p.top_p), np.bool_(p.do_sample),
-                    self._next_key(),
+                    self._request_key(req),
                 )
                 if self._cc is not None:
                     exe = self._ensure_program(
@@ -2037,4 +2155,9 @@ class Engine:
         req.finish_time = time.perf_counter()
         self._release(req)
         self.metrics.requests_finished += 1
+        if self.journal is not None:
+            # trailing tokens + terminal record, buffered; the step's
+            # group flush (or the next one, for between-step aborts)
+            # makes the completion durable
+            self.journal.finish(req, reason)
         finished.append(RequestOutput(req))

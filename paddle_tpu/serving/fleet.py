@@ -80,10 +80,16 @@ _fleet_counter = itertools.count(1)
 
 class FleetConfig:
     def __init__(self, num_replicas=2, hedge_after_s=None, max_restarts=2,
-                 restart_policy=None, analysis_check="error"):
+                 restart_policy=None, analysis_check="error",
+                 max_pending=None, journal_dir=None):
         if num_replicas < 1:
             raise ValueError(
                 f"num_replicas must be >= 1, got {num_replicas}"
+            )
+        if max_pending is not None and max_pending < 1:
+            raise ValueError(
+                f"max_pending must be >= 1 or None (unbounded), got "
+                f"{max_pending}"
             )
         if hedge_after_s is not None and hedge_after_s < 0:
             raise ValueError(
@@ -112,6 +118,20 @@ class FleetConfig:
         # decode-loop gate each replica runs at spawn/restart
         # (supervisor forwards to Engine.check_decode)
         self.analysis_check = analysis_check
+        # fleet admission bound: add_request raises
+        # EngineOverloadedError (the engine's shedding semantics) once
+        # this many requests are parked unroutable — an unplaceable
+        # backlog must push back on clients, not grow without limit.
+        # Failover re-enqueues and journal recovery bypass the bound:
+        # recovered work is never shed.
+        self.max_pending = (
+            None if max_pending is None else int(max_pending)
+        )
+        # durable request journal (serving/journal.py): a directory
+        # path or Journal shared by the WHOLE fleet at its front door.
+        # A restarting fleet replays it before traffic; see
+        # docs/serving.md "Request durability".
+        self.journal_dir = journal_dir
 
 
 class FleetMetrics:
@@ -122,6 +142,9 @@ class FleetMetrics:
     def __init__(self):
         self.requests_received = 0
         self.requests_finished = 0
+        self.requests_shed = 0        # bounced off the max_pending bound
+        self.requests_timeout = 0     # TTL-expired while parked pending
+        self.journal_replayed = 0     # requests recovered from the WAL
         self.failovers = 0            # replica deaths recovered from
         self.failover_requests = 0    # in-flight requests re-enqueued
         self.hedges_started = 0
@@ -153,6 +176,9 @@ class FleetMetrics:
 _FLEET_COUNTERS = {
     "requests_received": "paddle_tpu_fleet_requests_received_total",
     "requests_finished": "paddle_tpu_fleet_requests_finished_total",
+    "requests_shed": "paddle_tpu_fleet_requests_shed_total",
+    "requests_timeout": "paddle_tpu_fleet_requests_timeout_total",
+    "journal_replayed": "paddle_tpu_fleet_journal_replayed_total",
     "failovers": "paddle_tpu_fleet_failovers_total",
     "failover_requests": "paddle_tpu_fleet_failover_requests_total",
     "hedges_started": "paddle_tpu_fleet_hedges_started_total",
@@ -310,6 +336,14 @@ class Fleet:
     def __init__(self, model, engine_config=None, config=None):
         self.config = config or FleetConfig()
         self.engine_config = engine_config
+        if (engine_config is not None
+                and getattr(engine_config, "journal", None) is not None):
+            raise ValueError(
+                "EngineConfig(journal=) under a Fleet would make every "
+                "replica replay — and double-admit — the same journal; "
+                "use FleetConfig(journal_dir=) so the fleet journals "
+                "once at its front door"
+            )
         self._model = model
         self.fleet_id = f"{next(_fleet_counter)}"
         self.metrics = FleetMetrics()
@@ -325,6 +359,21 @@ class Fleet:
         # (Request, n_tokens_at_failover) pairs awaiting their first
         # post-failover token — the recovery-time probe
         self._recovering: list = []
+        # durable request journal at the fleet front door: replayed
+        # AFTER the replicas spawn (a shared compile cache has already
+        # warmed their programs — recovery re-prefills are zero-trace)
+        # and BEFORE any traffic is accepted
+        self.journal = None
+        if self.config.journal_dir is not None:
+            from .journal import resolve_journal
+
+            seed = (
+                engine_config.seed if engine_config is not None else 0
+            )
+            self.journal = resolve_journal(
+                self.config.journal_dir, seed=seed
+            )
+            self._replay_journal()
         _register_view(self)
 
         def _probe(ref=weakref.ref(self)):
@@ -344,6 +393,49 @@ class Fleet:
             max_restarts=cfg.max_restarts,
             analysis_check=cfg.analysis_check,
         )
+
+    # -- durable request journal ---------------------------------------------
+    def _replay_journal(self):
+        """Crash recovery at the fleet front door: unfinished journal
+        entries become FleetRequests at the HEAD of the pending queue
+        (oldest first), tokens intact — dispatch places them through
+        the resume() re-prefill path, so greedy continuations are
+        byte-identical and no journaled token is re-emitted. TTLs that
+        lapsed while the fleet was down retire as ``"timeout"``
+        without touching a replica. Recovered work bypasses
+        ``max_pending``: bounded admission must never drop requests
+        the fleet already accepted."""
+        entries = self.journal.replay()
+        # fleet rids are "fleet<id>-<n>": a fresh process restarts the
+        # counter at 0, which would collide new rids with replayed
+        # ones — advance past every journaled suffix
+        mx = -1
+        prefix = f"fleet{self.fleet_id}-"
+        for e in entries:
+            if isinstance(e.rid, str) and e.rid.startswith(prefix):
+                tail = e.rid[len(prefix):]
+                if tail.isdigit():
+                    mx = max(mx, int(tail))
+        if mx >= 0:
+            self._req_counter = itertools.count(mx + 1)
+        from .journal import restore_entries
+
+        live, expired = restore_entries(
+            self.journal, entries,
+            lambda e, params: FleetRequest(e.prompt, params, e.rid),
+        )
+        self.metrics.requests_timeout += expired
+        for freq in live:  # re-ADMIT in order, emit cursor carried
+            self.journal.admit(freq.request)
+        self.journal.flush()
+        self._pending.extendleft(reversed(live))
+        self.metrics.journal_replayed += len(live)
+        self.metrics.requests_received += len(live)
+        if entries:
+            _flight.record(
+                "fleet", "journal-recovered", fleet=self.fleet_id,
+                requests=len(live), expired=len(entries) - len(live),
+            )
 
     # -- introspection -------------------------------------------------------
     def replica(self, name):
@@ -406,6 +498,25 @@ class Fleet:
             raise NoReplicaError(
                 f"fleet {self.fleet_id}: all replicas permanently failed"
             )
+        cfg_f = self.config
+        if (cfg_f.max_pending is not None
+                and sum(not f.done for f in self._pending)
+                >= cfg_f.max_pending):
+            # counted over LIVE parked requests only: a done entry
+            # still parked (its hedge won after the primary's replica
+            # died; purged lazily at the queue head) is not backlog
+            # bounded admission (the engine's shedding semantics at
+            # fleet altitude): an unroutable backlog pushes back on
+            # the client instead of growing without limit
+            self.metrics.requests_shed += 1
+            _flight.record(
+                "fleet", "shed", fleet=self.fleet_id,
+                pending=len(self._pending),
+            )
+            raise EngineOverloadedError(
+                f"fleet {self.fleet_id} pending queue full "
+                f"({cfg_f.max_pending} parked); request shed"
+            )
         if request_id is None:
             request_id = f"fleet{self.fleet_id}-{next(self._req_counter)}"
         freq = FleetRequest(prompt_token_ids, sampling_params, request_id)
@@ -426,6 +537,11 @@ class Fleet:
             )
         self.metrics.requests_received += 1
         self._pending.append(freq)
+        if self.journal is not None:
+            # WAL the admission before dispatch: once flushed, a crash
+            # replays this request instead of losing it
+            self.journal.admit(freq.request)
+            self.journal.flush()
         self._dispatch_pending()
         return freq
 
@@ -493,6 +609,9 @@ class Fleet:
         freq.done = True
         freq.output = RequestOutput(req)
         self.metrics.requests_finished += 1
+        if self.journal is not None:
+            self.journal.finish(req, reason)
+            self.journal.flush()
         self._ready.append(freq.output)
 
     def step(self):
@@ -667,6 +786,7 @@ class Fleet:
         # calls can't consume the fresh-degraded admission gate
         for sup in self.replicas:
             sup.observe_errors()
+        self._expire_pending()
         self._dispatch_pending()
         if self.config.hedge_after_s is not None:
             self._maybe_hedge(time.perf_counter())
@@ -685,6 +805,17 @@ class Fleet:
                 continue
             for out in outs:
                 self._collect(out)
+        if self.journal is not None:
+            # batched EMIT across every primary in flight (the fleet
+            # owns the Request objects, which travel with their tokens
+            # across replicas) + one group write for the whole fleet
+            # step — a near-no-op until the write interval elapses or
+            # a completion makes the buffer urgent
+            self.journal.step_flush(
+                d.request
+                for d in self._routes.values()
+                if d.kind == "primary" and not d.cancelled
+            )
         if self._recovering:
             now = time.perf_counter()
             for req, n0 in list(self._recovering):
@@ -699,6 +830,39 @@ class Fleet:
                     # finished WITHOUT a new token (aborted/expired
                     # post-failover): not a recovery sample
                     self._recovering.remove((req, n0))
+
+    def _expire_pending(self):
+        """TTL enforcement for requests parked in the fleet pending
+        queue: engine-side expiry (``Engine._expire``) only sees
+        queued/running requests, so an UNROUTABLE request would
+        otherwise outlive its ``ttl_s`` indefinitely. Expired parked
+        requests finish with ``"timeout"`` — and any dispatch they
+        still hold from a past life (a failover-requeued request's
+        live hedge) is cancelled so it stops decoding for a client
+        that already timed out."""
+        if not self._pending:
+            return
+        now = time.perf_counter()
+        for freq in [
+            f for f in self._pending
+            if not f.done and f.request.expired(now)
+        ]:
+            self._pending.remove(freq)
+            self.metrics.requests_timeout += 1
+            for disp in freq.dispatches:
+                if disp.cancelled or disp.finished:
+                    continue
+                disp.cancelled = True
+                sup = self._sup_or_none(disp.replica)
+                if sup is not None and sup.engine is not None:
+                    sup.engine.abort(disp.request.request_id)
+            if freq.hedged:
+                self.metrics.hedges_lost += 1
+            _flight.record(
+                "fleet", "timeout", fleet=self.fleet_id,
+                request_id=freq.request_id, where="pending",
+            )
+            self._finish_local(freq, "timeout")
 
     def _poll_restarts(self):
         for sup in self.replicas:
@@ -920,6 +1084,11 @@ class Fleet:
         # see their own id regardless of which dispatch won
         out.request_id = freq.request_id
         freq.output = out
+        if self.journal is not None:
+            # the journal is keyed by the PRIMARY rid; a hedge winner
+            # closes it with the winning reason (the primary's partial
+            # tokens are irrelevant once the request is finished)
+            self.journal.finish(freq.request, out.finish_reason)
         if freq.hedged:
             if d.kind == "hedge":
                 self.metrics.hedges_won += 1

@@ -46,7 +46,7 @@ class SamplingParams:
 
     def __init__(self, max_new_tokens=16, do_sample=False, temperature=1.0,
                  top_k=0, top_p=1.0, eos_token_id=None, stop_token_ids=(),
-                 ttl_s=None):
+                 ttl_s=None, seed=None):
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}"
@@ -72,6 +72,15 @@ class SamplingParams:
         # wall-clock budget from arrival; the engine finishes the request
         # with finish_reason="timeout" once it expires (queued or running)
         self.ttl_s = None if ttl_s is None else float(ttl_s)
+        # per-request sampling seed: when set on a do_sample request,
+        # the request's per-request launches (prefill / final chunk)
+        # draw from fold_in(PRNGKey(seed), n_generated) instead of the
+        # engine's shared key stream — so the first sampled token is
+        # reproducible across restarts, replays, and failovers.
+        # Batched decode continuations keep the engine's per-step key
+        # stream (the documented sampled-replay caveat; greedy requests
+        # ignore this entirely). Journaled in the ADMIT record.
+        self.seed = None if seed is None else int(seed)
 
     @property
     def stop_ids(self):
@@ -80,6 +89,30 @@ class SamplingParams:
         if self.eos_token_id is not None:
             ids.add(int(self.eos_token_id))
         return ids
+
+    def to_dict(self):
+        """JSON-able form (the request journal's ADMIT payload)."""
+        return {
+            "max_new_tokens": self.max_new_tokens,
+            "do_sample": self.do_sample,
+            "temperature": self.temperature,
+            "top_k": self.top_k,
+            "top_p": self.top_p,
+            "eos_token_id": self.eos_token_id,
+            "stop_token_ids": list(self.stop_token_ids),
+            "ttl_s": self.ttl_s,
+            "seed": self.seed,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        """Inverse of :meth:`to_dict`. Unknown keys are ignored so a
+        journal written by a newer build still replays."""
+        known = (
+            "max_new_tokens", "do_sample", "temperature", "top_k",
+            "top_p", "eos_token_id", "stop_token_ids", "ttl_s", "seed",
+        )
+        return cls(**{k: d[k] for k in known if k in d})
 
 
 _request_counter = itertools.count()
@@ -118,6 +151,9 @@ class Request:
         self.last_token = None    # newest token, not yet in the cache
         self.slot = None
         self.admit_seq = -1       # admission order, for preemption policy
+        # durability: output tokens already written to the request
+        # journal (the emit cursor; journal.admit/emit own it)
+        self.journal_cursor = 0
         # metrics
         self.arrival_time = time.perf_counter()
         self.first_token_time = None

@@ -439,6 +439,61 @@ class TestFleetAPI:
         assert fleet.metrics.failovers == 1
 
 
+class TestBoundedAdmission:
+    """FleetConfig(max_pending=): the fleet pending queue pushes back
+    on clients with the engine's shedding semantics instead of growing
+    without bound, and parked requests honor their TTL."""
+
+    def test_max_pending_sheds_with_engine_semantics(self, model):
+        fleet = Fleet(
+            model, _engine_config(max_batch_slots=1, max_waiting=1),
+            FleetConfig(num_replicas=1, analysis_check=None,
+                        max_pending=1),
+        )
+        params = SamplingParams(max_new_tokens=8)
+        fleet.add_request([1, 2, 3], params)   # engine waiting queue
+        fleet.add_request([4, 5], params)      # refused there -> parks
+        assert len(fleet._pending) == 1
+        with pytest.raises(serving.EngineOverloadedError, match="shed"):
+            fleet.add_request([6, 7], params)
+        assert fleet.metrics.requests_shed == 1
+        # shed is flow control, not failure: the backlog still drains
+        while fleet.has_unfinished():
+            fleet.step()
+        assert fleet.metrics.requests_finished == 2
+        snap = fleet.snapshot()
+        assert snap["requests_shed"] == 1
+
+    def test_pending_ttl_expires_parked_requests(self, model):
+        """Engine-side expiry only sees queued/running requests; a
+        request parked UNROUTABLE in the fleet pending queue must not
+        outlive its ttl_s indefinitely."""
+        fleet = Fleet(
+            model, _engine_config(max_batch_slots=1, max_waiting=1),
+            FleetConfig(num_replicas=1, analysis_check=None),
+        )
+        params = SamplingParams(max_new_tokens=8)
+        fleet.add_request([1, 2, 3], params)
+        fleet.step()                            # running
+        fleet.add_request([4, 5], params)       # engine queue full ...
+        doomed = fleet.add_request(
+            [6, 7], SamplingParams(max_new_tokens=8, ttl_s=0.0),
+        )                                       # ... parks, expired
+        assert doomed.request_id not in fleet._routes
+        fleet.step()
+        assert doomed.done
+        assert doomed.output.finish_reason == "timeout"
+        assert fleet.metrics.requests_timeout == 1
+        # the survivors were untouched
+        while fleet.has_unfinished():
+            fleet.step()
+        assert fleet.metrics.requests_finished == 3
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="max_pending"):
+            FleetConfig(max_pending=0)
+
+
 class TestHitAwareRouting:
     """Prefix-affinity routing: a repeated system prompt routes to the
     replica whose prefix cache already holds its blocks, instead of

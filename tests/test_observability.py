@@ -247,10 +247,21 @@ class TestTracePropagation:
             with obs.span("client-op") as sp:
                 store.set("obs/k", "v")
                 assert store.get("obs/k") == "v"
-            names = {
-                s.name: s for s in obs.finished_spans()
-                if s.name.startswith("store.")
-            }
+            # the server sends each response INSIDE its remote_span
+            # (the span finishes — and lands in the ring — after the
+            # client already has the reply), so the last op's span can
+            # trail the client by a scheduler quantum: poll briefly
+            # instead of racing the handler thread
+            deadline = time.time() + 5.0
+            while True:
+                names = {
+                    s.name: s for s in obs.finished_spans()
+                    if s.name.startswith("store.")
+                }
+                if ({"store.set", "store.get"} <= set(names)
+                        or time.time() >= deadline):
+                    break
+                time.sleep(0.01)
             assert {"store.set", "store.get"} <= set(names)
             for s in names.values():
                 assert s.trace_id == sp.trace_id

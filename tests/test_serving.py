@@ -515,6 +515,48 @@ class TestGracefulDegradation:
         finally:
             engine.config.kv_shed_threshold = None
 
+    def test_generate_shed_retry_backs_off(self, model, small_engine):
+        """When every pending prompt is shed and nothing is in
+        flight, generate()'s submit loop used to spin on no-op step()
+        calls; it must back off through resilience.RetryPolicy and
+        resume cleanly once the pressure clears."""
+        from paddle_tpu.resilience.retry import RetryPolicy
+
+        eng = small_engine
+        shed0 = eng.metrics.requests_shed
+        real_submit, calls = eng.submit, {"n": 0}
+
+        def pressured_submit(req):
+            calls["n"] += 1
+            if calls["n"] <= 6:   # sustained synthetic KV pressure
+                eng.metrics.requests_shed += 1
+                raise EngineOverloadedError("pool saturated")
+            return real_submit(req)
+
+        sleeps = []
+        saved_backoff = eng._shed_backoff
+        eng.submit = pressured_submit
+        eng._shed_backoff = RetryPolicy(
+            max_attempts=None, deadline=float("inf"),
+            base_delay=0.001, max_delay=0.05, jitter=0.0, seed=0,
+            sleep=sleeps.append,
+        )
+        try:
+            outs = eng.generate(
+                [[1, 2, 3], [4, 5]], SamplingParams(max_new_tokens=3),
+            )
+        finally:
+            del eng.submit            # un-shadow the bound method
+            eng._shed_backoff = saved_backoff
+        # every fruitless shed iteration slept (exponential growth),
+        # no spin — and the counter nets out: internal retries are
+        # flow control, not client-visible rejections
+        assert len(sleeps) == 6
+        assert sleeps == sorted(sleeps) and sleeps[0] > 0
+        assert sleeps[-1] > 4 * sleeps[0]
+        assert [o.finish_reason for o in outs] == ["length"] * 2
+        assert eng.metrics.requests_shed == shed0
+
     def test_watchdog_probe_and_health_wiring(self, model):
         from paddle_tpu.distributed.watchdog import (
             disable_comm_watchdog,
