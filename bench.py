@@ -506,6 +506,38 @@ def bench_serving(paddle, on_tpu):
         "unit": "tokens/s",
     }))
 
+    # ---- streaming latency percentiles over the WARM timed run (the
+    # engine's own cumulative digests also hold the compile-heavy
+    # first run — a cold-replica tail worth scraping in production but
+    # noise as a tracked bench number): rebuild the digest from the
+    # warm run's per-request timelines, the same sketch the scrape
+    # exports
+    from paddle_tpu.observability.latency import LatencyDigest
+
+    dig = {"ttft": LatencyDigest(), "tpot": LatencyDigest()}
+    for o in outs:
+        for k in dig:
+            v = o.metrics[f"{k}_s"]
+            if v is not None:
+                dig[k].record(v)
+    ttft_p99 = dig["ttft"].quantile(0.99)
+    tpot_p99 = dig["tpot"].quantile(0.99)
+    log(f"[serving] warm-run latency digests: ttft p50/p99="
+        f"{dig['ttft'].quantile(0.5)*1e3:.1f}/{ttft_p99*1e3:.1f}ms "
+        f"tpot p50/p99={dig['tpot'].quantile(0.5)*1e3:.2f}/"
+        f"{tpot_p99*1e3:.2f}ms "
+        f"(n={dig['ttft'].count})")
+    print(json.dumps({
+        "metric": "serving_ttft_p99_ms",
+        "value": round(ttft_p99 * 1e3, 2),
+        "unit": "ms",
+    }))
+    print(json.dumps({
+        "metric": "serving_tpot_p99_ms",
+        "value": round(tpot_p99 * 1e3, 3),
+        "unit": "ms",
+    }))
+
     # ---- durable request journal: WAL cost on a mixed workload with
     # production-representative stream lengths (tens-to-hundreds of
     # output tokens — the 8..32-token smoke streams above would price
@@ -534,6 +566,34 @@ def bench_serving(paddle, on_tpu):
         page_size=16 if on_tpu else 8,
     )
     jroot = tempfile.mkdtemp(prefix="paddle_tpu_journal_bench_")
+
+    def floor_pair(eng_base, eng_inst, iters):
+        """Floor-to-floor overhead timing: run-to-run noise (scheduler
+        jitter, GC, XLA dispatch variance) is the same order as the
+        cost under test, so the engines run in interleaved pairs
+        (order alternating) and only the per-engine FLOOR — the one
+        statistic that converges here — is compared. Returns
+        ``(dt_base, dt_inst, overhead_pct)``."""
+        dt_base = dt_inst = None
+        for i in range(iters):
+            order = (
+                (eng_base, eng_inst) if i % 2 == 0
+                else (eng_inst, eng_base)
+            )
+            for engine in order:
+                t0 = time.perf_counter()
+                engine.generate(j_prompts, j_params)
+                dt = time.perf_counter() - t0
+                if engine is eng_base:
+                    dt_base = (
+                        dt if dt_base is None else min(dt_base, dt)
+                    )
+                else:
+                    dt_inst = (
+                        dt if dt_inst is None else min(dt_inst, dt)
+                    )
+        return dt_base, dt_inst, (dt_inst - dt_base) / dt_base * 100.0
+
     try:
         eng_p = Engine(model, EngineConfig(**j_kw))
         eng_j = Engine(model, EngineConfig(
@@ -541,30 +601,9 @@ def bench_serving(paddle, on_tpu):
         ))
         for engine in (eng_p, eng_j):
             engine.generate(j_prompts, j_params)   # warm programs
-        # run-to-run noise (scheduler jitter, GC, XLA dispatch
-        # variance) is the same order as the journal cost itself, so
-        # the engines are timed in interleaved pairs (order
-        # alternating) and compared FLOOR-to-floor — the floor is the
-        # only statistic that converges here
-        dt_plain = dt_journal = None
-        for i in range(8 if on_tpu else 24):
-            order = (
-                (eng_p, eng_j) if i % 2 == 0 else (eng_j, eng_p)
-            )
-            for engine in order:
-                t0 = time.perf_counter()
-                engine.generate(j_prompts, j_params)
-                dt = time.perf_counter() - t0
-                if engine is eng_p:
-                    dt_plain = (
-                        dt if dt_plain is None else min(dt_plain, dt)
-                    )
-                else:
-                    dt_journal = (
-                        dt if dt_journal is None
-                        else min(dt_journal, dt)
-                    )
-        overhead_pct = (dt_journal - dt_plain) / dt_plain * 100.0
+        dt_plain, dt_journal, overhead_pct = floor_pair(
+            eng_p, eng_j, 8 if on_tpu else 24,
+        )
         j = eng_j.journal
         log(f"[serving] journal overhead: {dt_journal:.3f}s vs "
             f"{dt_plain:.3f}s plain -> {overhead_pct:+.2f}% "
@@ -574,6 +613,28 @@ def bench_serving(paddle, on_tpu):
         print(json.dumps({
             "metric": "serving_journal_overhead_pct",
             "value": round(overhead_pct, 2),
+            "unit": "percent",
+        }))
+
+        # ---- access-log overhead: same floor-to-floor discipline as
+        # the journal pair (one JSONL line per finished request +
+        # always-on timelines vs the plain engine) — the <2% contract
+        eng_a = Engine(model, EngineConfig(
+            **j_kw, access_log=os.path.join(jroot, "alog"),
+        ))
+        eng_a.generate(j_prompts, j_params)   # warm programs
+        dt_plain2, dt_alog, alog_pct = floor_pair(
+            eng_p, eng_a, 8 if on_tpu else 24,
+        )
+        al = eng_a.access_log
+        log(f"[serving] access-log overhead: {dt_alog:.3f}s vs "
+            f"{dt_plain2:.3f}s plain -> {alog_pct:+.2f}% "
+            f"({al.records_written} lines, "
+            f"{al.bytes_written/1e3:.0f}KB, "
+            f"files={len(al.files())}, errors={al.write_errors})")
+        print(json.dumps({
+            "metric": "serving_accesslog_overhead_pct",
+            "value": round(alog_pct, 2),
             "unit": "percent",
         }))
     finally:
@@ -782,6 +843,25 @@ def bench_fleet(paddle, on_tpu):
     print(json.dumps({
         "metric": "fleet_failover_ms",
         "value": round(failover_ms, 1),
+        "unit": "ms",
+    }))
+
+    # merged-digest tail under failover: the pull-time merge of both
+    # replicas' latency digests (merge == pooled), sampled over the
+    # run that just lost a replica mid-decode — the p99 a client
+    # actually saw through the kill, not the surviving replica's view
+    merged = fleet.merged_latency()
+    p99 = merged["ttft"].quantile(0.99)
+    log(f"[fleet] merged digest under failover: ttft p50="
+        f"{merged['ttft'].quantile(0.5)*1e3:.1f}ms "
+        f"p99={p99*1e3:.1f}ms e2e p99="
+        f"{merged['e2e'].quantile(0.99)*1e3:.1f}ms "
+        f"(n={merged['ttft'].count} across "
+        f"{sum(1 for s in fleet.replicas if s.engine is not None)} "
+        f"replicas)")
+    print(json.dumps({
+        "metric": "fleet_merged_ttft_p99_ms",
+        "value": round(p99 * 1e3, 1),
         "unit": "ms",
     }))
 

@@ -26,7 +26,7 @@ after warmup" stops being a flaky bench and becomes a monitorable
 number. An optional scrape thread (``start_scrape_server``) serves
 ``/metrics`` and ``/healthz``.
 """
-from . import flight, jit_events, metrics, scrape, spans
+from . import flight, jit_events, latency, metrics, scrape, spans
 from .flight import (
     FlightRecorder,
     dump,
@@ -35,6 +35,7 @@ from .flight import (
     install_signal_handler,
     record,
 )
+from .latency import LatencyDigest, SLOConfig, SLOTracker
 from .metrics import (
     Counter,
     Gauge,
@@ -45,6 +46,7 @@ from .metrics import (
     gauge,
     get_registry,
     histogram,
+    register_latency_view,
 )
 from .scrape import (
     ScrapeServer,
@@ -68,6 +70,9 @@ __all__ = [
     # metrics
     "Counter", "Gauge", "Histogram", "MetricFamily", "MetricsRegistry",
     "counter", "gauge", "histogram", "get_registry",
+    "register_latency_view",
+    # latency digests + SLO burn
+    "LatencyDigest", "SLOConfig", "SLOTracker",
     # spans
     "Span", "span", "remote_span", "current_span", "current_trace_id",
     "current_traceparent", "finished_spans", "export_chrome_trace",
@@ -78,5 +83,5 @@ __all__ = [
     "ScrapeServer", "start_scrape_server", "register_health_provider",
     "unregister_health_provider", "health_snapshot",
     # submodules
-    "flight", "jit_events", "metrics", "scrape", "spans",
+    "flight", "jit_events", "latency", "metrics", "scrape", "spans",
 ]

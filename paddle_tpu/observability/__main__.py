@@ -6,10 +6,21 @@
     python -m paddle_tpu.observability metrics         # this process's
                                                        # exposition (mostly
                                                        # useful under -i)
+    python -m paddle_tpu.observability slo --url http://host:9100
+                                                       # live percentile/
+                                                       # burn snapshot
+    python -m paddle_tpu.observability slo --access-log DIR
+                                                       # offline summary
 
 Postmortems are written by ``observability.flight.dump`` on watchdog
 trips, unhandled engine errors, and SIGUSR2; they live under
-``$PADDLE_TPU_FLIGHT_DIR`` (default: the system temp dir).
+``$PADDLE_TPU_FLIGHT_DIR`` (default: the system temp dir). The ``slo``
+subcommand renders the current latency-percentile / SLO-burn picture
+either from a live scrape endpoint (it parses the
+``paddle_tpu_serving_latency_seconds`` summary and the burn gauges off
+``/metrics``) or offline from a serving access-log directory (it
+rebuilds the digests from the per-request JSONL lines; pass
+``--ttft-p99-ms`` / ``--tpot-p99-ms`` to compute burn against targets).
 """
 from __future__ import annotations
 
@@ -53,6 +64,27 @@ def _render_dump(payload, out):
                 f" sig={ev.get('signature')}"
                 + (f" {el:.3f}s" if el is not None else "")
                 + "\n"
+            )
+    tls = payload.get("request_timelines") or []
+    if tls:
+        out.write(
+            f"-- last {len(tls)} request timelines " + "-" * 30 + "\n"
+        )
+        for t in tls:
+            phases = " ".join(
+                f"{k[:-2]}={t[k]*1e3:.1f}ms"
+                for k in ("queue_wait_s", "ttft_s", "tpot_s", "e2e_s")
+                if isinstance(t.get(k), (int, float))
+            )
+            extra = " ".join(
+                f"{k}={t[k]}"
+                for k in ("prefill_chunks", "prefix_hit_tokens",
+                          "spec_accepted", "preemptions", "hops")
+                if t.get(k)
+            )
+            out.write(
+                f"  rid={t.get('rid')} [{t.get('finish_reason')}] "
+                f"{phases}" + (f" {extra}" if extra else "") + "\n"
             )
     events = payload.get("events") or []
     if events:
@@ -105,6 +137,190 @@ def _render_compilecache_summary(clog, m, out):
     )
 
 
+_PROM_LINE = None   # compiled lazily in _parse_prom
+
+
+def _parse_prom(text, family):
+    """``[(labels_dict, value)]`` for one family's plain samples out
+    of a Prometheus text exposition — just enough parser for the slo
+    subcommand (no suffixes, no escapes beyond the exporter's own)."""
+    import re
+
+    global _PROM_LINE
+    if _PROM_LINE is None:
+        _PROM_LINE = re.compile(
+            r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+            r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$"
+        )
+    out = []
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line.strip())
+        if m is None or m.group("name") != family:
+            continue
+        labels = {}
+        for part in (m.group("labels") or "").split(","):
+            if "=" in part:
+                k, v = part.split("=", 1)
+                labels[k.strip()] = v.strip().strip('"')
+        try:
+            out.append((labels, float(m.group("value"))))
+        except ValueError:
+            continue
+    return out
+
+
+def _render_slo_table(rows, out):
+    """``rows``: {scope: {phase: {quantile_str: value}}} -> one table
+    of milliseconds."""
+    qs = ("0.5", "0.9", "0.99")
+    out.write(f"{'scope':<12} {'phase':<8} "
+              + " ".join(f"{f'p{float(q)*100:g}':>10}" for q in qs)
+              + f" {'count':>8}\n")
+    for scope in sorted(rows):
+        for phase in sorted(rows[scope]):
+            vals = rows[scope][phase]
+            out.write(
+                f"{scope:<12} {phase:<8} "
+                + " ".join(
+                    f"{vals[q]*1e3:>8.1f}ms" if q in vals
+                    else f"{'-':>10}"
+                    for q in qs
+                )
+                + f" {int(vals.get('count', 0)):>8}\n"
+            )
+
+
+def _slo_live(url, out):
+    import urllib.request
+
+    text = urllib.request.urlopen(
+        url.rstrip("/") + "/metrics", timeout=10
+    ).read().decode()
+    rows: dict = {}
+    for labels, value in _parse_prom(
+        text, "paddle_tpu_serving_latency_seconds"
+    ):
+        scope = (
+            f"fleet {labels['fleet']}" if "fleet" in labels
+            else f"engine {labels.get('engine', '?')}"
+        )
+        phase = labels.get("phase", "?")
+        q = labels.get("quantile")
+        if q is not None:
+            rows.setdefault(scope, {}).setdefault(phase, {})[q] = value
+    for labels, value in _parse_prom(
+        text, "paddle_tpu_serving_latency_seconds_count"
+    ):
+        scope = (
+            f"fleet {labels['fleet']}" if "fleet" in labels
+            else f"engine {labels.get('engine', '?')}"
+        )
+        phase = labels.get("phase", "?")
+        rows.setdefault(scope, {}).setdefault(
+            phase, {}
+        )["count"] = value
+    if not rows:
+        out.write("no paddle_tpu_serving_latency_seconds series at "
+                  f"{url} (is a serving engine running?)\n")
+        return 1
+    _render_slo_table(rows, out)
+    burns = (
+        _parse_prom(text, "paddle_tpu_serving_slo_burn_rate")
+        + _parse_prom(text, "paddle_tpu_fleet_slo_burn_rate")
+    )
+    for labels, value in burns:
+        scope = ", ".join(
+            f"{k}={v}" for k, v in sorted(labels.items())
+            if k != "signal"
+        )
+        out.write(
+            f"burn[{labels.get('signal')}] {scope}: {value:.2f}x"
+            + ("  ** BURNING **" if value >= 1.0 else "") + "\n"
+        )
+    return 0
+
+
+def _slo_offline(directory, out, ttft_p99_ms=None, tpot_p99_ms=None):
+    from paddle_tpu.serving.access_log import iter_records
+
+    from .latency import LatencyDigest, SLOConfig, burn_from_counts
+
+    digests = {
+        p: LatencyDigest() for p in ("queue", "ttft", "tpot", "e2e")
+    }
+    reasons: dict = {}
+    counts: dict = {}
+    n = 0
+    for rec in iter_records(directory):
+        n += 1
+        reasons[rec.get("finish_reason")] = (
+            reasons.get(rec.get("finish_reason"), 0) + 1
+        )
+        aborted = rec.get("finish_reason") == "aborted"
+        for phase, key in (
+            ("queue", "queue_wait_s"), ("ttft", "ttft_s"),
+            ("tpot", "tpot_s"), ("e2e", "e2e_s"),
+        ):
+            if aborted and phase in ("tpot", "e2e"):
+                # mirror the live exclusion contract exactly: queue and
+                # ttft are event-time samples (an abort AFTER admission
+                # / first token keeps them live, so keep them here),
+                # while finish-time samples (tpot/e2e) and the SLO burn
+                # window exclude aborts — client aborts/hedge losers
+                # are logged for visibility, not as delivery latency
+                continue
+            v = rec.get(key)
+            if isinstance(v, (int, float)):
+                digests[phase].record(v)
+        if aborted:
+            continue
+        for sig, target in (("ttft", ttft_p99_ms),
+                            ("tpot", tpot_p99_ms)):
+            v = rec.get(f"{sig}_s")
+            if target is None or not isinstance(v, (int, float)):
+                continue
+            counts[f"{sig}_total"] = counts.get(f"{sig}_total", 0) + 1
+            if v * 1e3 > target:
+                counts[f"{sig}_violations"] = (
+                    counts.get(f"{sig}_violations", 0) + 1
+                )
+    if not n:
+        out.write(f"no access-log records under {directory}\n")
+        return 1
+    out.write(f"{n} request(s): " + " ".join(
+        f"{k}={v}" for k, v in sorted(reasons.items())
+    ) + "\n")
+    rows = {
+        "offline": {
+            p: {
+                **{
+                    f"{q:g}": d.quantile(q)
+                    for q in (0.5, 0.9, 0.99)
+                },
+                "count": d.count,
+            }
+            for p, d in digests.items() if d.count
+        }
+    }
+    _render_slo_table(rows, out)
+    if ttft_p99_ms is not None or tpot_p99_ms is not None:
+        cfg = SLOConfig(
+            ttft_p99_ms=ttft_p99_ms, tpot_p99_ms=tpot_p99_ms,
+        )
+        for sig, burn in sorted(
+            burn_from_counts(counts, cfg).items()
+        ):
+            if burn is None:
+                continue
+            out.write(
+                f"burn[{sig}] vs p99 target: {burn:.2f}x"
+                + ("  ** BURNING **" if burn >= 1.0 else "") + "\n"
+            )
+    return 0
+
+
 def main(argv=None):
     from . import flight, metrics
 
@@ -121,8 +337,35 @@ def main(argv=None):
         "--list", action="store_true", help="list available dumps"
     )
     sub.add_parser("metrics", help="print this process's exposition")
+    p_slo = sub.add_parser(
+        "slo",
+        help="latency percentile / SLO burn snapshot (live or offline)",
+    )
+    p_slo.add_argument(
+        "--url", help="scrape endpoint base URL (e.g. http://host:9100)"
+    )
+    p_slo.add_argument(
+        "--access-log", dest="access_log",
+        help="summarize a serving access-log directory offline",
+    )
+    p_slo.add_argument("--ttft-p99-ms", type=float, default=None)
+    p_slo.add_argument("--tpot-p99-ms", type=float, default=None)
     args = parser.parse_args(argv)
 
+    if args.cmd == "slo":
+        if bool(args.url) == bool(args.access_log):
+            print(
+                "slo needs exactly one of --url or --access-log",
+                file=sys.stderr,
+            )
+            return 2
+        if args.url:
+            return _slo_live(args.url, sys.stdout)
+        return _slo_offline(
+            args.access_log, sys.stdout,
+            ttft_p99_ms=args.ttft_p99_ms,
+            tpot_p99_ms=args.tpot_p99_ms,
+        )
     if args.cmd == "metrics":
         sys.stdout.write(metrics.get_registry().render_prometheus())
         return 0

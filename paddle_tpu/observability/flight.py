@@ -36,6 +36,7 @@ from collections import deque
 
 __all__ = [
     "FlightRecorder", "get_flight_recorder", "record", "dump",
+    "record_timeline", "timelines",
     "dump_dir", "find_dumps", "install_signal_handler",
 ]
 
@@ -45,8 +46,13 @@ _DUMP_PREFIX = "paddle_tpu-flight-"
 class FlightRecorder:
     """Thread-safe bounded event ring."""
 
-    def __init__(self, capacity=512):
+    def __init__(self, capacity=512, timeline_capacity=64):
         self._events = deque(maxlen=int(capacity))
+        # last-N finished/aborted request timelines (serving feeds one
+        # phase-breakdown dict per completed request): a postmortem
+        # shows what requests were DOING — queue waits, chunk counts,
+        # preemptions, hops — not just counters
+        self._timelines = deque(maxlen=int(timeline_capacity))
         self._lock = threading.Lock()
         self.dumps = 0          # postmortems written by this recorder
 
@@ -64,9 +70,20 @@ class FlightRecorder:
         with self._lock:
             return [dict(ev) for ev in self._events]
 
+    def record_timeline(self, entry):
+        """Append one finished-request timeline (a JSON-friendly dict;
+        one deque append — same cost contract as :meth:`record`)."""
+        with self._lock:
+            self._timelines.append(entry)
+
+    def timelines(self):
+        with self._lock:
+            return [dict(t) for t in self._timelines]
+
     def clear(self):
         with self._lock:
             self._events.clear()
+            self._timelines.clear()
 
     def __len__(self):
         with self._lock:
@@ -84,6 +101,16 @@ def get_flight_recorder():
 def record(category, name, **data):
     """Append an event to the process-wide flight recorder."""
     _recorder.record(category, name, **data)
+
+
+def record_timeline(entry):
+    """Append a finished-request timeline to the process-wide ring."""
+    _recorder.record_timeline(entry)
+
+
+def timelines():
+    """The process-wide recorder's last-N request timelines."""
+    return _recorder.timelines()
 
 
 def dump_dir():
@@ -130,6 +157,7 @@ def dump(reason, path=None, probes=None):
             "pid": os.getpid(),
             "argv": sys.argv,
             "events": _json_safe(_recorder.events()),
+            "request_timelines": _json_safe(_recorder.timelines()),
             "compile_log": _json_safe(jit_events.compile_log()),
             "metrics": _json_safe(metrics.get_registry().snapshot()),
             "probes": _json_safe(probes or {}),

@@ -32,6 +32,7 @@ import threading
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricFamily", "MetricsRegistry",
     "get_registry", "counter", "gauge", "histogram",
+    "register_latency_view",
 ]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -415,6 +416,50 @@ class MetricsRegistry:
                     ) + "}"
                 out[key] = value
         return out
+
+
+def register_latency_view(name, fn, prefix, labels=None,
+                          quantiles=None, registry=None):
+    """The digest collector-view kind: register a pull-time view over
+    mergeable :class:`~paddle_tpu.observability.latency.LatencyDigest`
+    sketches. ``fn()`` returns ``{phase: LatencyDigest}`` — evaluated
+    at scrape time only (zero hot-path registry cost, the same
+    contract as ``register_collector``) — and the view renders TWO
+    exposition families from it:
+
+      * ``<prefix>_seconds`` — a quantile-labeled summary
+        (``{phase=...,quantile=...}`` series plus ``_sum``/``_count``)
+      * ``<prefix>_hist_seconds`` — a Prometheus-native cumulative
+        histogram (``le``-bucketed) for recording rules and heatmaps
+
+    ``fn`` returning None unregisters the view (the weakref-collector
+    idiom). The serving engine registers its per-request phase digests
+    this way, and the fleet registers a replica-merged view under the
+    same prefix."""
+    from .latency import (
+        DEFAULT_QUANTILES, histogram_family, summary_family,
+    )
+
+    reg = registry or _default
+    qs = tuple(quantiles) if quantiles is not None else DEFAULT_QUANTILES
+    base = dict(labels or {})
+
+    def collect():
+        digests = fn()
+        if digests is None:
+            return None
+        fams = []
+        fam = summary_family(
+            f"{prefix}_seconds", digests, base, quantiles=qs
+        )
+        if fam.samples:
+            fams.append(fam)
+        fam = histogram_family(f"{prefix}_hist_seconds", digests, base)
+        if fam.samples:
+            fams.append(fam)
+        return fams
+
+    reg.register_collector(name, collect)
 
 
 _default = MetricsRegistry()

@@ -16,6 +16,7 @@ docs/serving.md for the architecture walkthrough.
     outs = engine.generate(prompt_token_lists,
                            serving.SamplingParams(max_new_tokens=64))
 """
+from .access_log import AccessLog
 from .adapter import LlamaServingAdapter, build_adapter
 from .engine import Engine, EngineConfig, EngineOverloadedError
 from .fleet import (
@@ -29,14 +30,21 @@ from .journal import Journal, ReplayEntry
 from .kv_cache import BlockManager, KVPool
 from .metrics import EngineMetrics
 from .prefix_cache import PrefixCache, PrefixMatch
-from .request import Request, RequestOutput, RequestState, SamplingParams
+from .request import (
+    Request,
+    RequestOutput,
+    RequestState,
+    RequestTimeline,
+    SamplingParams,
+)
 from .supervisor import ReplicaSupervisor
 
 __all__ = [
     "Engine", "EngineConfig", "EngineOverloadedError", "SamplingParams",
-    "Request", "RequestOutput", "RequestState", "BlockManager", "KVPool",
+    "Request", "RequestOutput", "RequestState", "RequestTimeline",
+    "BlockManager", "KVPool",
     "EngineMetrics", "LlamaServingAdapter", "build_adapter",
-    "PrefixCache", "PrefixMatch", "Journal", "ReplayEntry",
+    "PrefixCache", "PrefixMatch", "Journal", "ReplayEntry", "AccessLog",
     "Fleet", "FleetConfig", "FleetMetrics", "FleetRequest",
     "NoReplicaError", "ReplicaSupervisor",
 ]

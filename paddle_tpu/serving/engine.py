@@ -85,6 +85,7 @@ from ..observability import jit_events
 from ..observability import register_health_provider, span
 from ..observability import unregister_health_provider
 from ..resilience import faults
+from .access_log import record_finish
 from .adapter import build_adapter
 from .kv_cache import BlockManager, KVPool
 from .metrics import EngineMetrics
@@ -143,7 +144,8 @@ class EngineConfig:
                  prefix_cache_blocks=None, prefill_chunk_tokens=None,
                  max_prefill_chunks_per_step=1, speculate_tokens=None,
                  speculate_ngram=3, decode_kernel="auto",
-                 kv_cache_dtype=None, journal=None):
+                 kv_cache_dtype=None, journal=None, access_log=None,
+                 slo=None):
         if max_batch_slots < 1:
             raise ValueError("max_batch_slots must be >= 1")
         if page_size < 1 or max_model_len < 2:
@@ -303,6 +305,24 @@ class EngineConfig:
         # process-local. For a Fleet use FleetConfig(journal_dir=)
         # instead: replicas share one fleet-level journal.
         self.journal = journal
+        # structured JSONL access log (serving/access_log.py): a
+        # directory path or AccessLog. One line per finished request
+        # (rid, trace id, phase breakdown, finish reason), rotating
+        # files, every failure degrading via the obs.accesslog fault
+        # site — never fatal. None disables.
+        self.access_log = access_log
+        # latency SLO (observability.latency.SLOConfig): when set, the
+        # engine tracks windowed TTFT/TPOT error-budget burn; sustained
+        # burn flips health()["flags"] — and /healthz — to degraded.
+        if slo is not None:
+            from ..observability.latency import SLOConfig
+
+            if not isinstance(slo, SLOConfig):
+                raise TypeError(
+                    f"slo must be an observability.SLOConfig or None, "
+                    f"got {type(slo).__name__}"
+                )
+        self.slo = slo
         self.seed = int(seed)
 
 
@@ -324,6 +344,20 @@ class Engine:
         # (paddle_tpu_serving_* series labeled engine=<id>)
         self.metrics = EngineMetrics(engine_id=self.engine_id)
         cfg = self.config
+        # per-request observability: the JSONL access log (shared per
+        # directory — fleet replicas append to one log) and the SLO
+        # burn tracker the collector view + health() read
+        self.access_log = None
+        if cfg.access_log is not None:
+            from .access_log import resolve_access_log
+
+            self.access_log = resolve_access_log(cfg.access_log)
+        self.slo = None
+        if cfg.slo is not None:
+            from ..observability.latency import SLOTracker
+
+            self.slo = SLOTracker(cfg.slo)
+            self.metrics.slo = self.slo
         # pool dtype: the adapter may declare it; default to the embed
         # table's dtype for dict-shaped weights (the Llama adapter)
         dtype = getattr(self.adapter, "dtype", None)
@@ -1186,6 +1220,7 @@ class Engine:
         req.state = RequestState.WAITING
         self.waiting.appendleft(req)
         self.metrics.requests_received += 1
+        req.timeline.resumes += 1
         if self.journal is not None and not self._journal_replaying:
             # re-ADMIT with the emit cursor: replay must not re-count
             # the tokens this request already produced elsewhere
@@ -1199,7 +1234,10 @@ class Engine:
         ``finish_reason="aborted"`` emitted by the NEXT ``step()``), so
         drivers blocked on the request's completion — ``generate()``,
         a fleet drain — observe it instead of waiting forever. Aborts
-        are not failures: nothing lands in the flight ring."""
+        are not failures (no error probe, no postmortem dump), but the
+        request's timeline still lands in the flight timeline ring and
+        the access log — excluded from the finish-time latency
+        digests/SLO window (see docs/observability.md)."""
         for req in list(self.waiting):
             if req.request_id == request_id:
                 self.waiting.remove(req)
@@ -1354,8 +1392,15 @@ class Engine:
             cfg.kv_shed_threshold is not None
             and util_active >= cfg.kv_shed_threshold
         )
+        # sustained SLO error-budget burn degrades the replica so an
+        # external load balancer rotates it out (503 via /healthz).
+        # The in-process fleet router deliberately does NOT unroute on
+        # it (supervisor.routable gates on overload/fresh errors):
+        # serving slowly beats not serving, and unrouting every slow
+        # replica at once would turn a latency incident into an outage
+        slo_burning = self.slo is not None and self.slo.burning()
         degraded = bool(
-            m.requests_errored or m.requests_timeout
+            m.requests_errored or m.requests_timeout or slo_burning
             or (wd is not None and wd.fired is not None)
         )
         overloaded = queue_full or shedding
@@ -1369,8 +1414,14 @@ class Engine:
             "flags": [
                 f for f, on in (
                     ("degraded", degraded), ("overloaded", overloaded),
+                    ("slo_burn", slo_burning),
                 ) if on
             ],
+            # windowed error-budget burn per signal (None = no SLO /
+            # no samples); burn 1.0 = spending the budget as allotted
+            "slo_burn_rates": (
+                self.slo.burn_rates() if self.slo is not None else None
+            ),
             "queue_depth": len(self.waiting),
             "num_running": sum(r is not None for r in self.slots),
             # kernel-path observability: which decode attention path
@@ -1492,6 +1543,16 @@ class Engine:
             req.state = RequestState.PREFILLING
             req.admit_seq = self._admit_counter
             self._admit_counter += 1
+            # timeline: queue wait ends at the FIRST slot assignment
+            # (re-admissions after preemption keep the original stamp;
+            # the hop list tracks which engines admitted it)
+            tl = req.timeline
+            first_admission = tl.admitted is None
+            tl.mark_admitted(self.engine_id)
+            if first_admission:
+                self.metrics.latency["queue"].record(tl.queue_wait_s)
+            if match is not None:
+                tl.prefix_hit_tokens += match.cache_len
             if match is not None and match.cow_src is not None:
                 # the cap cut into the last shared block: this request
                 # will WRITE its final prefill token there, so it gets
@@ -1574,6 +1635,8 @@ class Engine:
         req.num_cached = len(tokens)
         self.metrics.prefill_tokens += len(tokens)
         self.metrics.prefill_steps += 1
+        req.timeline.prefill_chunks += 1
+        req.timeline.prefill_tokens += len(tokens)
         self._finish_prefill(req, tok)
 
     def _finish_prefill(self, req, tok):
@@ -1585,6 +1648,7 @@ class Engine:
             req.last_token = req.output_token_ids[-1]
         else:
             req.first_token_time = time.perf_counter()
+            req.timeline.first_token = req.first_token_time
             self.metrics.record_ttft(
                 req.first_token_time - req.arrival_time
             )
@@ -1702,6 +1766,8 @@ class Engine:
         self.metrics.prefill_tokens += len(chunk)
         self.metrics.prefill_steps += 1
         self.metrics.prefill_chunks += 1
+        req.timeline.prefill_chunks += 1
+        req.timeline.prefill_tokens += len(chunk)
         if final:
             self._finish_prefill(req, tok)
 
@@ -1800,6 +1866,7 @@ class Engine:
         req.num_cached = 0
         self.waiting.appendleft(req)
         self.metrics.preemptions += 1
+        req.timeline.preemptions += 1
         _flight.record(
             "serving", "preemption", engine=self.engine_id,
             request_id=req.request_id,
@@ -1967,6 +2034,7 @@ class Engine:
             req.output_token_ids.append(tok)
             req.last_token = tok
             self.metrics.decode_tokens += 1
+            req.timeline.decode_tokens += 1
             reason = req.check_stop(cfg.max_model_len)
             if reason:
                 self._finish(req, reason, finished)
@@ -2105,12 +2173,14 @@ class Engine:
             a = speculation.accept_length(
                 tokens[i, 1: 1 + dlen], tgt[i, :dlen]
             )
+            req.timeline.verify_steps += 1
             if dlen:
                 # zero-draft slots (nothing to look up, no block
                 # slack) are plain decodes, not speculation samples
                 m.spec_proposed += dlen
                 m.spec_accepted += a
                 m.record_spec_accept(a)
+                req.timeline.spec_accepted += a
             # emit targets 0..a: the accepted drafts' successors plus
             # the bonus token the rejected/terminal position scored.
             # Their K/V is already in the pages (draft j == target j-1
@@ -2124,6 +2194,7 @@ class Engine:
                 req.output_token_ids.append(tok)
                 req.last_token = tok
                 m.decode_tokens += 1
+                req.timeline.decode_tokens += 1
                 reason = req.check_stop(cfg.max_model_len)
                 if reason:
                     # stop inside the window (EOS mid-draft, length):
@@ -2153,6 +2224,16 @@ class Engine:
         req.finish_reason = reason
         req.state = RequestState.FINISHED
         req.finish_time = time.perf_counter()
+        # timeline finalization: close the phase record, then the
+        # shared finish accounting (access_log.record_finish) — e2e/
+        # tpot digests + SLO window (client aborts excluded: not
+        # latency samples), access-log line + flight timeline ring
+        # (aborts included). All host-side, once per REQUEST.
+        req.timeline.mark_finish(reason, req.finish_time)
+        record_finish(
+            req, latency=self.metrics.latency, slo=self.slo,
+            access_log=self.access_log, engine=self.engine_id,
+        )
         self._release(req)
         self.metrics.requests_finished += 1
         if self.journal is not None:

@@ -48,13 +48,22 @@ from __future__ import annotations
 
 import collections
 import itertools
+import threading
 import time
 import weakref
 
 from ..observability import MetricFamily, get_registry
 from ..observability import flight as _flight
 from ..observability import register_health_provider, span
+from ..observability.latency import (
+    LatencyDigest,
+    SLOTracker,
+    burn_from_counts,
+    sustained_burn,
+)
+from ..observability.metrics import register_latency_view
 from ..resilience import faults
+from .access_log import record_finish
 from .engine import Engine, EngineConfig, EngineOverloadedError
 from .prefix_cache import prompt_chain_digests
 from .request import (
@@ -197,6 +206,18 @@ def _register_view(fleet):
     ref = weakref.ref(fleet)
     name = f"serving.fleet.{fleet.fleet_id}"
 
+    def latency_view():
+        fl = ref()
+        return None if fl is None else fl.merged_latency()
+
+    # replica digests merged AT PULL TIME (merge == pooled, so the
+    # fleet-labeled paddle_tpu_serving_latency_seconds series is
+    # exactly what one engine serving all the traffic would export)
+    register_latency_view(
+        f"serving.fleet.latency.{fleet.fleet_id}", latency_view,
+        "paddle_tpu_serving_latency", labels={"fleet": fleet.fleet_id},
+    )
+
     def collect():
         fl = ref()
         if fl is None:
@@ -250,9 +271,37 @@ def _register_view(fleet):
                 pfill.add(em.prefill_tokens, rl)
                 reclaimable.add(em.kv_reclaimable_blocks, rl)
         fams += [up, restarts, pfx_hits, pfx_tokens, pfill, reclaimable]
+        cfg, pooled = fl._slo_pool()
+        if cfg is not None:
+            # fleet-level burn from POOLED windows (the per-replica
+            # math over summed counts — a replica serving 10x the
+            # traffic weighs 10x, which per-replica averaging loses);
+            # one pool walk feeds both gauges
+            burn = MetricFamily("paddle_tpu_fleet_slo_burn_rate",
+                                "gauge")
+            for sig, v in sorted(burn_from_counts(pooled, cfg).items()):
+                if v is not None:
+                    burn.add(v, {**label, "signal": sig})
+            if burn.samples:
+                fams.append(burn)
+            fams.append(MetricFamily(
+                "paddle_tpu_fleet_slo_burning", "gauge",
+            ).add(1.0 if sustained_burn(pooled, cfg) else 0.0, label))
         return fams
 
     get_registry().register_collector(name, collect)
+
+
+def _merge_digests(dst, src):
+    """Fold a phase→LatencyDigest dict into another (merge-or-copy per
+    phase) — the ONE merge semantic behind both the pull-time
+    ``merged_latency`` view and the death-time ``_absorb_latency``
+    fold, so the two can never diverge."""
+    for phase, d in src.items():
+        if phase in dst:
+            dst[phase].merge(d)
+        else:
+            dst[phase] = d.copy()
 
 
 class _Dispatch:
@@ -347,6 +396,31 @@ class Fleet:
         self._model = model
         self.fleet_id = f"{next(_fleet_counter)}"
         self.metrics = FleetMetrics()
+        # fleet-local observability for requests that finish WITHOUT
+        # reaching an engine (parked timeout, pending abort,
+        # unplaceable): the overload tail is exactly what must not
+        # vanish from the digests/SLO/access log, so _finish_local
+        # records here and merged_latency()/_slo_pool() fold it in
+        self._local_latency = {
+            p: LatencyDigest() for p in ("queue", "ttft", "tpot", "e2e")
+        }
+        # makes absorb-and-drop atomic against a concurrent scrape's
+        # merged_latency(): a dying replica's samples must move from
+        # its engine digests to the fleet-local set in ONE observable
+        # step, or the merged _count double-counts (or dips — either
+        # reads as a counter reset to Prometheus) mid-failover
+        self._latency_lock = threading.Lock()
+        self._local_slo = None
+        self._access_log = None
+        if engine_config is not None:
+            if engine_config.slo is not None:
+                self._local_slo = SLOTracker(engine_config.slo)
+            if engine_config.access_log is not None:
+                from .access_log import resolve_access_log
+
+                self._access_log = resolve_access_log(
+                    engine_config.access_log
+                )
         self.replicas: list = []
         for i in range(self.config.num_replicas):
             sup = self._make_supervisor(f"r{i}")
@@ -459,22 +533,100 @@ class Fleet:
     def health(self):
         """Fleet health snapshot (scrape /healthz provider): "ok" while
         at least one replica is routable, "degraded" while live-but-
-        unroutable replicas remain, "failed" when the fleet is gone."""
+        unroutable replicas remain (or the POOLED SLO window is
+        burning — replicas can each sit under the per-replica sample
+        floor while the fleet as a whole blows the objective),
+        "failed" when the fleet is gone."""
         statuses = {s.name: s.status for s in self.replicas}
         routable = sum(s.routable() for s in self.replicas)
-        if routable:
-            status = "ok"
-        elif self.size():
-            status = "degraded"
-        else:
+        # ONE pool walk per probe: burning and the rates derive from
+        # the same counts (each _slo_pool takes every tracker's lock)
+        cfg, pooled = self._slo_pool()
+        burning = cfg is not None and sustained_burn(pooled, cfg)
+        if not self.size():
             status = "failed"
+        elif routable and not burning:
+            status = "ok"
+        else:
+            status = "degraded"
         return {
             "status": status,
             "replicas": statuses,
             "routable": routable,
             "pending": len(self._pending),
             "in_flight": len(self._routes),
+            "slo_burn": burning,
+            "slo_burn_rates": (
+                burn_from_counts(pooled, cfg)
+                if cfg is not None else None
+            ),
         }
+
+    def _absorb_latency(self, sup):
+        """Fold a dying/rebuilding replica's cumulative latency digests
+        into the fleet-local set and drop its engine, atomically with
+        respect to ``merged_latency`` — the merged summary's
+        _count/_sum must stay monotonic across failovers and rolling
+        restarts (a concurrent scrape must never see the samples in
+        both places, or in neither), and the killed replica's samples
+        ARE the failover tail the merged view exists to keep. (The
+        replica's short SLO window dies with it: burn is a now-signal
+        and a dead replica is not serving.)"""
+        with self._latency_lock:
+            eng, sup.engine = sup.engine, None
+            if eng is not None:
+                _merge_digests(self._local_latency, eng.metrics.latency)
+        return eng
+
+    def merged_latency(self):
+        """Per-phase latency digests merged across live replicas at
+        call time — identical to one pooled digest by the merge
+        invariant — seeded with the fleet-local samples (requests
+        that finished without reaching an engine). The fleet-level
+        percentile source (collector view, bench, operators via
+        ``observability slo``)."""
+        with self._latency_lock:
+            # one consistent cut: local copies + the engine refs they
+            # do NOT yet include (engine digests have their own locks;
+            # merging outside ours is safe once the cut is taken)
+            merged = {
+                p: d.copy() for p, d in self._local_latency.items()
+            }
+            engines = [
+                s.engine for s in self.replicas if s.engine is not None
+            ]
+        for eng in engines:
+            _merge_digests(merged, eng.metrics.latency)
+        return merged
+
+    def _slo_pool(self):
+        """``(config, pooled_window_counts)`` across replica SLO
+        trackers (None config when no replica tracks an SLO). Pooling
+        the raw window counts — not the per-replica burn rates —
+        weighs each replica by its actual traffic."""
+        cfg, pooled = None, {}
+        trackers = [self._local_slo] if self._local_slo else []
+        trackers += [
+            sup.engine.slo for sup in self.replicas
+            if sup.engine is not None and sup.engine.slo is not None
+        ]
+        for t in trackers:
+            if cfg is None:
+                cfg = t.config
+            for k, v in t.window_counts().items():
+                pooled[k] = pooled.get(k, 0) + v
+        return cfg, pooled
+
+    def slo_burn_rates(self):
+        """Fleet-level burn per signal, or None without an SLO."""
+        cfg, pooled = self._slo_pool()
+        return burn_from_counts(pooled, cfg) if cfg is not None else None
+
+    def slo_burning(self):
+        """Sustained fleet-level burn: the per-engine predicate
+        (``latency.sustained_burn``) over pooled counts."""
+        cfg, pooled = self._slo_pool()
+        return cfg is not None and sustained_burn(pooled, cfg)
 
     def snapshot(self):
         """Fleet counters + per-replica status, one JSON-friendly
@@ -606,6 +758,17 @@ class Fleet:
         req.finish_reason = reason
         req.state = RequestState.FINISHED
         req.finish_time = time.perf_counter()
+        # close the timeline too (a request that timed out parked
+        # still deserves a phase breakdown on RequestOutput.metrics),
+        # then the SAME finish accounting an engine would do — local
+        # digests (e2e at least; queue/ttft belong to whatever engine
+        # life it had, which already recorded them), SLO window,
+        # access-log line, flight ring — via the shared helper
+        req.timeline.mark_finish(reason, req.finish_time)
+        record_finish(
+            req, latency=self._local_latency, slo=self._local_slo,
+            access_log=self._access_log, fleet=self.fleet_id,
+        )
         freq.done = True
         freq.output = RequestOutput(req)
         self.metrics.requests_finished += 1
@@ -743,7 +906,7 @@ class Fleet:
                 )
             self.drain(sup)
             with span("fleet.restart", replica=sup.name, rolling=True):
-                sup.engine = None
+                self._absorb_latency(sup)  # folds digests, drops engine
                 try:
                     sup.spawn()
                 except Exception as e:
@@ -1053,6 +1216,16 @@ class Fleet:
                 freq.prompt_token_ids, freq.sampling_params,
                 request_id=f"{freq.request_id}::hedge",
             )
+            # the hedge serves the SAME client request: anchor its
+            # timeline (and TTL deadline) at the primary's arrival so
+            # a hedge win reports the latency the client actually saw
+            # — including the stall that triggered the hedge — instead
+            # of restarting the clock at hedge dispatch (the aborted
+            # primary is excluded from the digests, so the winner's
+            # sample is the only record of this request's tail)
+            hreq.arrival_time = freq.request.arrival_time
+            hreq.timeline.arrival = hreq.arrival_time
+            hreq.deadline = freq.request.deadline
             with span(
                 "fleet.hedge", request_id=freq.request_id,
                 replica=target.name,
@@ -1126,6 +1299,7 @@ class Fleet:
             # analysis: allow(broad-except) the engine is torn by
             # definition here; the postmortem records that instead
             probe = {"error": f"health() failed: {he!r}"}
+        self._absorb_latency(sup)  # folds digests, drops engine
         sup.quarantine(exc)
         with span("fleet.failover", replica=sup.name, error=error):
             # slot requests resume via appendleft on the survivor, so

@@ -226,6 +226,18 @@ def restore_entries(journal, entries, build):
             obj = build(e, params)
             req = getattr(obj, "request", obj)
             req.output_token_ids = list(e.out)
+            if e.ts is not None:
+                # timeline coherence: anchor arrival at the journaled
+                # wall-clock admission (the same field the TTL math
+                # uses), mapped into this incarnation's perf_counter
+                # domain. Without this a recovered request's TTFT/e2e
+                # would be measured from the RESTART — the post-crash
+                # latency digests would report impossibly fast
+                # recoveries instead of the downtime the client saw.
+                age = max(0.0, now - e.ts)
+                req.arrival_time = time.perf_counter() - age
+                req.timeline.arrival = req.arrival_time
+                req.timeline.recovered = True
             if remaining is not None:
                 # anchored at the ORIGINAL admission, not the restart
                 # (perf_counter does not survive the process)

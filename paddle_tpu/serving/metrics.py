@@ -68,9 +68,22 @@ def _register_view(metrics, engine_id):
     time, holds the metrics object by weakref (a dead engine's view
     returns None and the registry drops it)."""
     from ..observability import MetricFamily, get_registry
+    from ..observability.metrics import register_latency_view
 
     ref = weakref.ref(metrics)
     label = {"engine": engine_id}
+
+    def latency_view():
+        m = ref()
+        return None if m is None else m.latency
+
+    # digest collector-view kind: renders the per-phase quantile
+    # summary (paddle_tpu_serving_latency_seconds{phase,quantile})
+    # plus the native cumulative histogram, all at pull time
+    register_latency_view(
+        f"serving.latency.{engine_id}", latency_view,
+        "paddle_tpu_serving_latency", labels=label,
+    )
 
     def collect():
         m = ref()
@@ -102,6 +115,22 @@ def _register_view(metrics, engine_id):
             fam.add(total, label, "_sum")
             fam.add(acc, label, "_count")
             fams.append(fam)
+        tracker = m.slo
+        if tracker is not None:
+            # SLO error-budget burn (windowed): burn 1.0 = spending
+            # the budget exactly as allotted; the burning gauge is the
+            # boolean that also flips health()["flags"]
+            fam = MetricFamily(
+                "paddle_tpu_serving_slo_burn_rate", "gauge",
+            )
+            for sig, v in sorted(tracker.burn_rates().items()):
+                if v is not None:
+                    fam.add(v, {**label, "signal": sig})
+            if fam.samples:
+                fams.append(fam)
+            fams.append(MetricFamily(
+                "paddle_tpu_serving_slo_burning", "gauge",
+            ).add(1.0 if tracker.burning() else 0.0, label))
         return fams
 
     get_registry().register_collector(f"serving.engine.{engine_id}",
@@ -164,9 +193,22 @@ class EngineMetrics:
         self.kv_reclaimable_blocks = 0
         self.prefix_cache_blocks = 0
         self.pool_high_water = 0
-        # latency
-        self._ttft_sum = 0.0
-        self._ttft_count = 0
+        # latency digests: one mergeable quantile sketch per phase
+        # (observability.latency.LatencyDigest). Recorded once per
+        # first-token / finished-request event, read at pull time by
+        # the latency collector view; mean_ttft derives from the ttft
+        # digest so the back-compat mean_ttft_s gauge and the exported
+        # p50 share one source and can never disagree.
+        from ..observability.latency import LatencyDigest
+
+        self.latency = {
+            phase: LatencyDigest()
+            for phase in ("queue", "ttft", "tpot", "e2e")
+        }
+        # SLO burn tracker (observability.latency.SLOTracker) attached
+        # by the engine when EngineConfig(slo=) is set; exported as
+        # burn-rate gauges by the collector view
+        self.slo = None
         # registry view (see module docstring), registered LAST: a
         # scrape racing engine construction must find every attribute
         # snapshot() reads already in place. The engine id labels this
@@ -175,8 +217,7 @@ class EngineMetrics:
             _register_view(self, engine_id)
 
     def record_ttft(self, seconds):
-        self._ttft_sum += seconds
-        self._ttft_count += 1
+        self.latency["ttft"].record(seconds)
 
     def record_spec_accept(self, n):
         """One verify launch accepted ``n`` draft tokens for one
@@ -200,9 +241,11 @@ class EngineMetrics:
 
     @property
     def mean_ttft(self):
-        return (
-            self._ttft_sum / self._ttft_count if self._ttft_count else None
-        )
+        """Derived from the ttft digest (exact sum/count — NOT a
+        bucket approximation), keeping the deprecated ``mean_ttft_s``
+        gauge consistent-by-construction with the exported
+        percentiles. See docs/observability.md for the deprecation."""
+        return self.latency["ttft"].mean
 
     def tokens_per_second(self):
         dt = time.perf_counter() - self.start_time

@@ -14,8 +14,10 @@ import enum
 import itertools
 import time
 
+from ..observability.spans import current_trace_id
+
 __all__ = ["RequestState", "SamplingParams", "Request", "RequestOutput",
-           "normalize_sampling_params"]
+           "RequestTimeline", "normalize_sampling_params"]
 
 
 def normalize_sampling_params(prompts, sampling_params):
@@ -115,6 +117,115 @@ class SamplingParams:
         return cls(**{k: d[k] for k in known if k in d})
 
 
+class RequestTimeline:
+    """Per-request lifecycle record: monotonic phase stamps plus the
+    event counters that explain a tail sample (how many chunks, how
+    many prefix-cache tokens, how many preemptions/hops). Every field
+    is a plain attribute bumped host-side by the engine — no registry,
+    no allocation beyond the hop list — so the timeline rides every
+    request at effectively zero per-step cost. Surfaced on
+    ``RequestOutput.metrics`` and fed into the engine's latency
+    digests at finish (docs/observability.md "Latency & SLO").
+
+    Phase definitions (all from ``arrival``, ``time.perf_counter``):
+
+      queue_wait  arrival -> first slot assignment (``admitted``)
+      ttft        arrival -> first generated token
+      decode      first token -> finish
+      e2e         arrival -> finish
+      tpot        decode / (output_tokens - 1), the steady-state
+                  inter-token latency (None for single-token outputs)
+    """
+
+    __slots__ = (
+        "arrival", "admitted", "first_token", "finish", "finish_reason",
+        "prefill_chunks", "prefill_tokens", "prefix_hit_tokens",
+        "decode_tokens", "verify_steps", "spec_accepted", "preemptions",
+        "resumes", "hops", "recovered",
+    )
+
+    def __init__(self, arrival):
+        self.arrival = arrival      # perf_counter at Request creation
+        self.admitted = None        # first slot assignment
+        self.first_token = None
+        self.finish = None
+        self.finish_reason = None
+        self.prefill_chunks = 0     # prefill launches (1 = one-shot)
+        self.prefill_tokens = 0     # tokens actually computed
+        self.prefix_hit_tokens = 0  # prompt tokens served from cache
+        self.decode_tokens = 0      # tokens emitted by decode/verify
+        self.verify_steps = 0       # speculative verify launches
+        self.spec_accepted = 0      # draft tokens accepted
+        self.preemptions = 0        # KV-pressure recompute preemptions
+        self.resumes = 0            # external resume() calls (failover)
+        self.hops = []              # engine ids that admitted it
+        self.recovered = False      # re-admitted from the journal
+
+    def mark_admitted(self, engine_id, now=None):
+        if now is None:
+            now = time.perf_counter()
+        if self.admitted is None:
+            self.admitted = now
+        if not self.hops or self.hops[-1] != engine_id:
+            self.hops.append(engine_id)
+        return now
+
+    def mark_finish(self, reason, now=None):
+        self.finish = now if now is not None else time.perf_counter()
+        self.finish_reason = reason
+        return self.finish
+
+    # -- derived phases (None until the transition happened) ---------------
+    @property
+    def queue_wait_s(self):
+        return (
+            self.admitted - self.arrival
+            if self.admitted is not None else None
+        )
+
+    @property
+    def ttft_s(self):
+        return (
+            self.first_token - self.arrival
+            if self.first_token is not None else None
+        )
+
+    @property
+    def e2e_s(self):
+        return (
+            self.finish - self.arrival
+            if self.finish is not None else None
+        )
+
+    def tpot_s(self, n_output_tokens):
+        if (self.finish is None or self.first_token is None
+                or n_output_tokens < 2):
+            return None
+        return (self.finish - self.first_token) / (n_output_tokens - 1)
+
+    def snapshot(self, n_output_tokens=0):
+        """JSON-friendly phase breakdown — the access-log line body,
+        the flight-recorder timeline entry, and
+        ``RequestOutput.metrics``."""
+        return {
+            "queue_wait_s": self.queue_wait_s,
+            "ttft_s": self.ttft_s,
+            "tpot_s": self.tpot_s(n_output_tokens),
+            "e2e_s": self.e2e_s,
+            "finish_reason": self.finish_reason,
+            "prefill_chunks": self.prefill_chunks,
+            "prefill_tokens": self.prefill_tokens,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "decode_tokens": self.decode_tokens,
+            "verify_steps": self.verify_steps,
+            "spec_accepted": self.spec_accepted,
+            "preemptions": self.preemptions,
+            "resumes": self.resumes,
+            "hops": list(self.hops),
+            "recovered": self.recovered,
+        }
+
+
 _request_counter = itertools.count()
 
 
@@ -158,6 +269,16 @@ class Request:
         self.arrival_time = time.perf_counter()
         self.first_token_time = None
         self.finish_time = None
+        # per-request lifecycle timeline (phase stamps + counters);
+        # journal replay re-anchors .arrival at the journaled
+        # wall-clock arrival so recovered requests' TTFT/e2e include
+        # the downtime instead of reading impossibly fast
+        self.timeline = RequestTimeline(self.arrival_time)
+        # trace attribution captured at CREATION, on the submitting
+        # thread: at finish time the stepping thread's ambient span
+        # belongs to whatever batch happened to be running, not to
+        # this request's client
+        self.trace_id = current_trace_id()
         self.deadline = (
             self.arrival_time + self.sampling_params.ttl_s
             if self.sampling_params.ttl_s is not None else None
@@ -209,6 +330,13 @@ class RequestOutput:
         self.latency = (
             request.finish_time - request.arrival_time
             if request.finish_time is not None else None
+        )
+        # phase breakdown + lifecycle counters (queue wait, TTFT,
+        # TPOT, e2e, chunks, cache hits, speculation, preemptions,
+        # failover hops) — the per-request view the latency digests
+        # aggregate
+        self.metrics = request.timeline.snapshot(
+            len(request.output_token_ids)
         )
 
     def __repr__(self):

@@ -114,6 +114,11 @@ class TestFailover:
         assert m.failover_requests >= 1
         assert m.failover_recovery_s is not None
         assert m.failover_recovery_s >= 0.0
+        # the dead replica's latency samples were absorbed into the
+        # fleet-local digests before its engine was dropped: every
+        # finish so far (warm round + failover round) is still in the
+        # merged summary, whichever replica served it
+        assert fleet.merged_latency()["e2e"].count == 2 * len(prompts)
         # the survivor's decode program never retraced (the counter is
         # bumped INSIDE the traced body): failover re-prefills resumed
         # requests, it does not change the decode shape
@@ -172,6 +177,10 @@ class TestFailover:
         again = fleet.generate(prompts[:4], params)
         for got, want in zip(again, ref[:4]):
             assert got.token_ids == want.token_ids
+        # monotonic after the restart too: r0 rejoined with EMPTY
+        # digests (the absorbed copy lives fleet-local, not on the
+        # rebuilt engine — no double counting)
+        assert fleet.merged_latency()["e2e"].count == 2 * len(prompts) + 4
 
 
 class TestHedging:
@@ -200,6 +209,38 @@ class TestHedging:
         assert not fleet.has_unfinished()
         for sup in fleet.replicas:
             assert sup.engine.block_manager.num_used == 0
+
+    def test_hedge_anchors_primary_arrival(self, model):
+        """A hedge serves the SAME client request: its timeline and TTL
+        budget anchor at the primary's arrival, so a hedge win reports
+        the stall the client actually waited through (the aborted
+        primary is excluded from the digests — the winner's sample is
+        the only record of this request's tail)."""
+        fleet = Fleet(
+            model, _engine_config(max_batch_slots=2),
+            FleetConfig(num_replicas=2, hedge_after_s=0.02,
+                        analysis_check=None),
+        )
+        freq = fleet.add_request(
+            [1, 2, 3], SamplingParams(max_new_tokens=8)
+        )
+        fleet.step()            # primary dispatched
+        time.sleep(0.05)        # stall past the hedge deadline
+        fleet.step()            # hedge fires
+        hd = next(
+            (d for d in fleet._routes.values() if d.kind == "hedge"),
+            None,
+        )
+        assert hd is not None, "hedge did not fire"
+        prim = hd.fleet_req.request
+        assert hd.request.arrival_time == prim.arrival_time
+        assert hd.request.timeline.arrival == prim.timeline.arrival
+        assert hd.request.deadline == prim.deadline
+        while fleet.has_unfinished():
+            fleet.step()
+        # whichever dispatch won, the client-visible e2e covers the
+        # stall that triggered the hedge
+        assert freq.output.metrics["e2e_s"] >= 0.05
 
     def test_hedging_disabled_by_default(self, model):
         fleet = Fleet(model, _engine_config(), FleetConfig(
