@@ -777,6 +777,51 @@ def bench_serving(paddle, on_tpu):
         "value": round(step_ms, 3),
         "unit": "ms",
     }))
+
+    # ---- tensor-parallel sharded engine (serving/sharding.py): the
+    # same mixed workload as the headline row through a tp=2 engine —
+    # every program one single-launch SPMD program over the 1 x tp
+    # mesh, the KV pool's head dim sharded so per-chip KV bytes drop
+    # ~tp-fold. Parity with the single-chip outputs is asserted
+    # in-bench (exact-mode numerics). Skips cleanly when the backend
+    # exposes one device (the normal single-chip CPU smoke; force more
+    # with --xla_force_host_platform_device_count).
+    import jax as _jax
+
+    tp = 2 if len(_jax.devices()) >= 2 else 1
+    if tp == 1:
+        log("[serving] tensor-parallel row skipped: one device visible")
+        for metric in ("serving_tp_tokens_per_s",
+                       "serving_tp_kv_bytes_per_chip"):
+            print(json.dumps({"metric": metric, "skipped": True}))
+    else:
+        eng_tp = Engine(model, EngineConfig(
+            max_batch_slots=slots, max_model_len=mml,
+            page_size=16 if on_tpu else 8, tp_degree=tp,
+        ))
+        eng_tp.generate(prompts, params)    # compile + warm
+        t0 = time.perf_counter()
+        outs_tp = eng_tp.generate(prompts, params)
+        dt_tp = time.perf_counter() - t0
+        assert ([o.token_ids for o in outs_tp]
+                == [o.token_ids for o in outs]), "tp broke parity"
+        tp_tps = sum(len(o.token_ids) for o in outs_tp) / dt_tp
+        per_chip = eng_tp.pool.bytes_per_token_per_chip()
+        single = eng.pool.bytes_per_token()
+        log(f"[serving] tensor-parallel tp={tp}: {tp_tps:,.0f} tokens/s "
+            f"(single-chip row {tps:,.0f}); KV "
+            f"{per_chip:,.0f} B/token/chip vs {single:,.0f} single-chip "
+            f"({per_chip / single:.2f}x)")
+        print(json.dumps({
+            "metric": "serving_tp_tokens_per_s",
+            "value": round(tp_tps, 1),
+            "unit": "tokens/s",
+        }))
+        print(json.dumps({
+            "metric": "serving_tp_kv_bytes_per_chip",
+            "value": round(per_chip, 1),
+            "unit": "bytes/token",
+        }))
     return tps
 
 

@@ -37,6 +37,7 @@ from .request import (
     RequestTimeline,
     SamplingParams,
 )
+from .sharding import TPSpec, build_tp_mesh
 from .supervisor import ReplicaSupervisor
 
 __all__ = [
@@ -46,5 +47,5 @@ __all__ = [
     "EngineMetrics", "LlamaServingAdapter", "build_adapter",
     "PrefixCache", "PrefixMatch", "Journal", "ReplayEntry", "AccessLog",
     "Fleet", "FleetConfig", "FleetMetrics", "FleetRequest",
-    "NoReplicaError", "ReplicaSupervisor",
+    "NoReplicaError", "ReplicaSupervisor", "TPSpec", "build_tp_mesh",
 ]

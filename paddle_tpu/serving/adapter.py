@@ -78,6 +78,23 @@ def _split_pages(pages):
     return pages, None
 
 
+def _row_matmul(x, w, spec):
+    """The row-parallel contraction (attention output projection / FFN
+    down projection) under tensor parallelism. ``spec`` is the engine's
+    ``serving.sharding.TPSpec`` (None on an unsharded engine — this
+    compiles the exact ``x @ w`` jaxpr the inline form did). Under
+    ``tp_numerics="exact"`` BOTH operands are constrained to replicated
+    before the dot — an all-gather of the sharded weight — so the
+    reduction runs whole on every chip and the result is bit-identical
+    to the unsharded program. ``"fast"`` leaves the operands sharded
+    and GSPMD emits the Megatron partial-sum + all-reduce, whose
+    cross-chip reduction order drifts ~1 ulp (docs/serving.md)."""
+    if spec is not None and spec.exact:
+        x = jax.lax.with_sharding_constraint(x, spec.replicated)
+        w = jax.lax.with_sharding_constraint(w, spec.replicated)
+    return x @ w
+
+
 def _paged_attn(q, kp, vp, block_tables, lengths, kernel="auto"):
     # pallas imports stay function-scoped (the nn_ops.py pattern): plain
     # `import paddle_tpu` must not load — nor fail on — the TPU kernel
@@ -191,6 +208,27 @@ def _write_window_pages(pages, kv, phys, slot):
     return (buf, scales)
 
 
+def _window_routing(block_tables, pos, valid, n_blocks, bs_pg):
+    """Physical scatter coordinates (phys, slot) for a [slots, S]
+    window of GLOBAL positions: row token ``pos`` lands in page
+    ``block_table[pos // bs_pg]`` at slot ``pos % bs_pg``; invalid
+    positions route to the nonexistent page ``n_blocks`` so the
+    scatter drops them — the out-of-bounds-drop contract every page
+    write shares (the gather clamp alone would silently overwrite a
+    live slot). One implementation serves the verify window write and
+    decode's tensor-parallel write (a 1-token window)."""
+    phys = jnp.where(
+        valid,
+        jnp.take_along_axis(
+            block_tables,
+            jnp.minimum(pos // bs_pg, block_tables.shape[1] - 1),
+            axis=1,
+        ),
+        n_blocks,
+    )
+    return phys, pos % bs_pg
+
+
 def _gather_context_batch(pages, block_tables):
     """``_gather_context`` for every slot at once: ``block_tables``
     [slots, P] gathers to ``[slots, P*bs, kv_heads, d]`` — slot s's
@@ -245,6 +283,15 @@ class LlamaServingAdapter:
     # decode attention path: "auto" | "pallas" | "xla" (module
     # docstring); the engine sets this from EngineConfig(decode_kernel=)
     decode_kernel = "auto"
+    # tensor-parallel sharding spec (serving.sharding.TPSpec); the
+    # engine sets this from EngineConfig(tp_degree=) — None (the
+    # default) keeps every traced body byte-identical to the
+    # single-chip program. The traced bodies consult it at two points:
+    # the row-parallel matmuls (_row_matmul numerics contract) and the
+    # decode-step page write (head-sliced scatter that stays
+    # shard-local where update_pages' explicit head indices would
+    # re-shard the pool under GSPMD).
+    tp_spec = None
 
     def __init__(self, model):
         cfg = model.config
@@ -298,7 +345,9 @@ class LlamaServingAdapter:
 
     def _mlp(self, wl, x):
         h = _rms_norm(x, wl["ln2"], epsilon=self.eps)
-        return x + _swiglu(h @ wl["wg"], h @ wl["wu"]) @ wl["wd"]
+        return x + _row_matmul(
+            _swiglu(h @ wl["wg"], h @ wl["wu"]), wl["wd"], self.tp_spec
+        )
 
     def _logits(self, w, x):
         head = w["head"]
@@ -328,7 +377,9 @@ class LlamaServingAdapter:
             # causal attention over the in-flight prompt; right-padding is
             # invisible to valid queries under causality
             attn = _sdpa(q, k, v, is_causal=True)
-            x = x + attn.reshape(1, s, -1) @ wl["wo"]
+            x = x + _row_matmul(
+                attn.reshape(1, s, -1), wl["wo"], self.tp_spec
+            )
             x = self._mlp(wl, x)
         x = _rms_norm(x, w["norm"], epsilon=self.eps)
         h_last = jnp.take(x[0], length - 1, axis=0)    # [hid]
@@ -382,7 +433,9 @@ class LlamaServingAdapter:
                 kc = jnp.repeat(kc, rep, axis=2)
                 vc = jnp.repeat(vc, rep, axis=2)
             attn = _sdpa(q, kc, vc, keep, is_causal=False)
-            x = x + attn.reshape(1, s, -1) @ wl["wo"]
+            x = x + _row_matmul(
+                attn.reshape(1, s, -1), wl["wo"], self.tp_spec
+            )
             x = self._mlp(wl, x)
         x = _rms_norm(x, w["norm"], epsilon=self.eps)
         h_last = jnp.take(x[0], length - 1, axis=0)     # [hid]
@@ -394,10 +447,23 @@ class LlamaServingAdapter:
         from ..kernels.pallas.paged_attention import update_pages
 
         b = tokens.shape[0]
-        capacity = block_tables.shape[1] * _pages_geometry(kp[0])[1]
+        n_blocks, bs_pg = _pages_geometry(kp[0])
+        capacity = block_tables.shape[1] * bs_pg
         # inactive slots: write position at capacity -> update_pages drops
         write_pos = jnp.where(active, positions, capacity)
         lengths = positions + 1   # the new token attends to itself
+        if self.tp_spec is not None:
+            # sharded pool: precompute the head-sliced scatter routing
+            # (_write_window_pages with a 1-token window). update_pages
+            # scatters with EXPLICIT kv-head indices, which GSPMD
+            # cannot prove shard-local on a head-sharded pool — the
+            # window form leaves the head dim a full slice, so every
+            # chip scatters only its own heads. Values written are
+            # identical either way (same routing trick, same casts).
+            wpos = write_pos[:, None]                  # [slots, 1]
+            dphys, dslot = _window_routing(
+                block_tables, wpos, wpos < capacity, n_blocks, bs_pg,
+            )
         x = w["embed"][tokens]                         # [slots, hid]
         kp, vp = list(kp), list(vp)
         for li in range(self.num_layers):
@@ -405,14 +471,21 @@ class LlamaServingAdapter:
             h = _rms_norm(x, wl["ln1"], epsilon=self.eps)
             q, k, v = self._qkv(wl, h[:, None, :], b, 1)
             q, k = _rope_qk(q, k, positions[:, None], base=self.rope_theta)
-            kp[li], vp[li] = update_pages(
-                kp[li], vp[li], k[:, 0], v[:, 0], block_tables, write_pos
-            )
+            if self.tp_spec is not None:
+                kp[li] = _write_window_pages(kp[li], k, dphys, dslot)
+                vp[li] = _write_window_pages(vp[li], v, dphys, dslot)
+            else:
+                kp[li], vp[li] = update_pages(
+                    kp[li], vp[li], k[:, 0], v[:, 0], block_tables,
+                    write_pos,
+                )
             attn = _paged_attn(
                 q[:, 0], kp[li], vp[li], block_tables, lengths,
                 kernel=self.decode_kernel,
             )                                          # [slots, heads, d]
-            x = x + attn.reshape(b, -1) @ wl["wo"]
+            x = x + _row_matmul(
+                attn.reshape(b, -1), wl["wo"], self.tp_spec
+            )
             x = self._mlp(wl, x)
         x = _rms_norm(x, w["norm"], epsilon=self.eps)
         return self._logits(w, x), tuple(kp), tuple(vp)
@@ -452,16 +525,9 @@ class LlamaServingAdapter:
             & (offs <= draft_lens[:, None])
             & (pos < capacity)
         )
-        phys = jnp.where(
-            valid,
-            jnp.take_along_axis(
-                block_tables,
-                jnp.minimum(pos // bs_pg, block_tables.shape[1] - 1),
-                axis=1,
-            ),
-            n_blocks,                                      # scatter drop
+        phys, slot = _window_routing(
+            block_tables, pos, valid, n_blocks, bs_pg,
         )
-        slot = pos % bs_pg
         # keep[q, c] per slot: context position c visible to window
         # token q — causal over the global timeline, so a valid query
         # only ever sees history plus THIS launch's earlier writes
@@ -487,7 +553,9 @@ class LlamaServingAdapter:
                 kc = jnp.repeat(kc, rep, axis=2)
                 vc = jnp.repeat(vc, rep, axis=2)
             attn = _sdpa(q, kc, vc, keep, is_causal=False)
-            x = x + attn.reshape(b, s, -1) @ wl["wo"]
+            x = x + _row_matmul(
+                attn.reshape(b, s, -1), wl["wo"], self.tp_spec
+            )
             x = self._mlp(wl, x)
         x = _rms_norm(x, w["norm"], epsilon=self.eps)
         return self._logits(w, x), tuple(kp), tuple(vp)

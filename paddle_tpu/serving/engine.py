@@ -67,6 +67,15 @@ Engine counters live in ``metrics.EngineMetrics``; the compile counters
 are incremented inside the traced step bodies, so they move only when XLA
 actually retraces — the probe behind the no-recompile-after-warmup
 guarantee.
+
+Tensor parallelism (``EngineConfig(tp_degree=N, devices=)``,
+serving/sharding.py): the same engine over N chips — weights sharded
+col/row-wise and the KV pool's head dim split over a 1 x N mesh, every
+program above still ONE single-launch SPMD program (GSPMD places the
+collectives; the scheduler and every probe are chip-count-blind), with
+``tp_numerics="exact"`` keeping outputs byte-identical to the
+unsharded engine. ``tp_degree=1`` (default) is byte-identical to the
+engine as it always was: no mesh, no placement, same jaxprs.
 """
 from __future__ import annotations
 
@@ -145,7 +154,8 @@ class EngineConfig:
                  max_prefill_chunks_per_step=1, speculate_tokens=None,
                  speculate_ngram=3, decode_kernel="auto",
                  kv_cache_dtype=None, journal=None, access_log=None,
-                 slo=None):
+                 slo=None, tp_degree=1, devices=None,
+                 tp_numerics="exact"):
         if max_batch_slots < 1:
             raise ValueError("max_batch_slots must be >= 1")
         if page_size < 1 or max_model_len < 2:
@@ -323,6 +333,50 @@ class EngineConfig:
                     f"got {type(slo).__name__}"
                 )
         self.slo = slo
+        # tensor-parallel sharded serving (serving/sharding.py):
+        # tp_degree > 1 builds a 1 x tp mesh over ``devices`` (jax
+        # Device objects or integer ids; None takes the first
+        # tp_degree of jax.devices()), shards the adapter weights
+        # col/row-wise and the KV pool's head dim over it, and runs
+        # every serving program as ONE single-launch SPMD program.
+        # tp_degree=1 (the default) is byte-identical to the
+        # single-chip engine — no mesh, no placement, same jaxprs.
+        if int(tp_degree) < 1:
+            raise ValueError(
+                f"tp_degree must be >= 1, got {tp_degree}"
+            )
+        self.tp_degree = int(tp_degree)
+        # materialized ONCE: a generator argument must not be consumed
+        # by validation and then read empty at engine build
+        self.devices = list(devices) if devices is not None else None
+        if self.devices is not None and self.tp_degree == 1:
+            # refusing beats silently ignoring: an operator pinning
+            # per-replica chips must not discover at capacity review
+            # that every tp=1 replica stacked on the default device
+            raise ValueError(
+                "EngineConfig(devices=) requires tp_degree > 1: a "
+                "single-chip engine runs on the process's default "
+                "device (devices= only places the tensor-parallel "
+                "mesh)"
+            )
+        if (self.devices is not None
+                and len(self.devices) != self.tp_degree):
+            raise ValueError(
+                f"EngineConfig(devices=) has {len(self.devices)} "
+                f"entries but tp_degree={self.tp_degree} needs "
+                f"exactly {self.tp_degree}"
+            )
+        # cross-chip numerics for the two row-parallel contractions:
+        # "exact" (default) gathers the sharded operand so reductions
+        # run whole on every chip — greedy outputs byte-identical to
+        # the unsharded engine; "fast" is the Megatron partial-sum +
+        # all-reduce, ~1 ulp reduction-order drift (docs/serving.md)
+        if tp_numerics not in ("exact", "fast"):
+            raise ValueError(
+                f'tp_numerics must be "exact" or "fast", got '
+                f"{tp_numerics!r}"
+            )
+        self.tp_numerics = tp_numerics
         self.seed = int(seed)
 
 
@@ -358,15 +412,36 @@ class Engine:
 
             self.slo = SLOTracker(cfg.slo)
             self.metrics.slo = self.slo
+        # tensor-parallel sharding (serving/sharding.py): validated and
+        # built BEFORE the pool exists so a bad degree raises one clear
+        # ValueError/TypeError naming the flag and dimension instead of
+        # a deep XLA mesh failure at first launch
+        self.tp = None
+        if cfg.tp_degree > 1:
+            from .sharding import build_tp_spec
+
+            self.tp = build_tp_spec(self.adapter, cfg)
         # pool dtype: the adapter may declare it; default to the embed
         # table's dtype for dict-shaped weights (the Llama adapter)
         dtype = getattr(self.adapter, "dtype", None)
         if dtype is None:
             dtype = self.adapter.weights["embed"].dtype
+        # under TP the pool allocates DIRECTLY on the mesh (pages
+        # sharded on the kv-head dim when GQA allows): a pool sized to
+        # N chips' combined KV budget must never transiently
+        # materialize whole on one chip — that transient IS the
+        # single-chip RESOURCE_EXHAUSTED ceiling this feature removes
         self.pool = KVPool(
             self.adapter.num_layers, self.adapter.num_kv_heads,
             cfg.num_blocks, cfg.page_size, self.adapter.head_dim, dtype,
             quant_dtype=cfg.kv_cache_dtype,
+            sharding=(
+                self.tp.pool_sharding if self.tp is not None else None
+            ),
+            shard_degree=(
+                self.tp.tp_degree
+                if self.tp is not None and self.tp.kv_sharded else 1
+            ),
         )
         # decode-kernel selection lives on the adapter (the traced
         # decode body reads it). ALWAYS assigned when the knob exists —
@@ -375,8 +450,29 @@ class Engine:
         # cache signatures and health claim THIS config). A non-default
         # request against an adapter without the knob fails HERE with
         # the config flag named, not at first trace.
+        self._decode_kernel = cfg.decode_kernel
+        if self.tp is not None and cfg.decode_kernel != "xla":
+            # the Pallas paged kernel has no SPMD partitioning rule: a
+            # sharded pool routes decode attention through the XLA
+            # gather path. An EXPLICIT "pallas" request degrades —
+            # warned once, counted, never fatal (the fallback computes
+            # the same math); "auto" just resolves to the available
+            # path, no warning.
+            if cfg.decode_kernel == "pallas":
+                from ..kernels.pallas._compat import record_fallback
+
+                record_fallback(
+                    "paged_attention", "sharding",
+                    hint=(
+                        "tensor-parallel serving "
+                        f"(EngineConfig(tp_degree={cfg.tp_degree})) "
+                        "shards the KV pool; the kernel cannot run "
+                        "under SPMD yet"
+                    ),
+                )
+            self._decode_kernel = "xla"
         if hasattr(self.adapter, "decode_kernel"):
-            self.adapter.decode_kernel = cfg.decode_kernel
+            self.adapter.decode_kernel = self._decode_kernel
         elif cfg.decode_kernel != "auto":
             raise TypeError(
                 f"{type(self.adapter).__name__} has no decode_kernel "
@@ -384,6 +480,39 @@ class Engine:
                 f"{cfg.decode_kernel!r}) needs an adapter that can "
                 "select its decode attention path"
             )
+        # TP spec mirrors the decode-kernel discipline: always
+        # (re)assigned when the attribute exists so a reused adapter
+        # cannot leak a previous engine's mesh into this one's traced
+        # programs; a sharded engine over an adapter without the knob
+        # fails HERE with the flag named.
+        if hasattr(self.adapter, "tp_spec"):
+            self.adapter.tp_spec = self.tp
+        elif self.tp is not None:
+            raise TypeError(
+                f"{type(self.adapter).__name__} has no tp_spec "
+                f"attribute, but EngineConfig(tp_degree="
+                f"{cfg.tp_degree}) needs an adapter whose traced "
+                "bodies honor a tensor-parallel sharding spec"
+            )
+        # the weight tree launches pass to the compiled programs. A
+        # sharded engine holds its OWN placed copy instead of mutating
+        # adapter.weights — a shared adapter must not leak one engine's
+        # mesh placement into another engine's launches (the same
+        # anti-leak discipline as decode_kernel/tp_spec, but weights
+        # cannot be "re-assigned back"). tp_degree=1 keeps reading the
+        # adapter's tree dynamically, so ``refresh()`` after a weight
+        # swap still propagates; a SHARDED engine binds at build —
+        # rebuild it (or ``Fleet.rolling_restart(model=)``) to swap.
+        self._tp_weights = None
+        if self.tp is not None:
+            # placement: weights per the col/row plan (the pool was
+            # already allocated sharded above) — health() exports the
+            # measured per-chip byte figure either way
+            self._tp_weights = self.tp.shard_weights(
+                self.adapter.weights
+            )
+        # exported as the paddle_tpu_serving_tp_degree gauge
+        self.metrics.tp_degree = cfg.tp_degree
         self.block_manager = BlockManager(cfg.num_blocks, cfg.page_size)
         self.prefix_cache = None
         if cfg.enable_prefix_cache:
@@ -555,20 +684,46 @@ class Engine:
         self._prefill_ext_fn = prefill_ext_fn
         self._cow_fn = cow_fn
         self._verify_fn = verify_fn
+        # tensor parallelism: pin the traced bodies' OUT shardings to
+        # the pool's placement (tokens replicated). Outputs must
+        # round-trip the input sharding exactly — a drifting output
+        # placement would miss the compiled program's input layout on
+        # the next launch and retrace, breaking the single-compile
+        # probe. In shardings ride on the committed input arrays (lazy
+        # path) / the sharding-attached abstract args (AOT path).
+        if self.tp is not None:
+            kp_sh, vp_sh = self.tp.pool_out_shardings(self.pool)
+            rep = self.tp.replicated
+            osh = {
+                "prefill": (rep, kp_sh, vp_sh),
+                "decode": (rep, kp_sh, vp_sh),
+                "prefill_ext": (rep, kp_sh, vp_sh),
+                "cow": (kp_sh, vp_sh),
+                "verify": (rep, kp_sh, vp_sh),
+            }
+            jkw = lambda kind: {"out_shardings": osh[kind]}
+        else:
+            jkw = lambda kind: {}
         self._prefill_jit = jax.jit(
-            prefill_fn, donate_argnums=donate, static_argnums=(11,)
+            prefill_fn, donate_argnums=donate, static_argnums=(11,),
+            **jkw("prefill"),
         )
         self._decode_jit = jax.jit(
-            decode_fn, donate_argnums=donate, static_argnums=(12,)
+            decode_fn, donate_argnums=donate, static_argnums=(12,),
+            **jkw("decode"),
         )
         self._prefill_ext_jit = jax.jit(
-            prefill_ext_fn, donate_argnums=donate, static_argnums=(12,)
+            prefill_ext_fn, donate_argnums=donate, static_argnums=(12,),
+            **jkw("prefill_ext"),
         )
         self._cow_jit = jax.jit(
             cow_fn,
             donate_argnums=(0, 1) if self._pool_donated else (),
+            **jkw("cow"),
         )
-        self._verify_jit = jax.jit(verify_fn, donate_argnums=donate)
+        self._verify_jit = jax.jit(
+            verify_fn, donate_argnums=donate, **jkw("verify")
+        )
         cfg = self.config
         self._chunking = cfg.prefill_chunk_tokens is not None
         self._use_ext = self._chunking or cfg.enable_prefix_cache
@@ -630,9 +785,17 @@ class Engine:
         cfg = self.config
         n = cfg.max_batch_slots
         sds = jax.ShapeDtypeStruct
-        w = abstractify(self.adapter.weights)
-        kp = abstractify(self.pool.k)
-        vp = abstractify(self.pool.v)
+        if self.tp is not None:
+            # shardings attached: AOT lowering sees the exact operand
+            # placements the lazy path's committed arrays carry, so the
+            # cached executable IS the program a cold launch compiles
+            w = self.tp.abstract(self._launch_weights())
+            kp = self.tp.abstract(self.pool.k)
+            vp = self.tp.abstract(self.pool.v)
+        else:
+            w = abstractify(self._launch_weights())
+            kp = abstractify(self.pool.k)
+            vp = abstractify(self.pool.v)
         key = sds(self._base_key.shape, self._base_key.dtype)
         if kind == "prefill":
             return (
@@ -691,9 +854,18 @@ class Engine:
         # signature_str, and adding a constant to the other kinds'
         # signatures would invalidate every pre-existing on-disk
         # program for nothing
+        # tp joins the signature only when sharding is on (keeps every
+        # pre-existing single-chip on-disk program valid); dk is the
+        # EFFECTIVE kernel (a sharded engine's "pallas" degraded to
+        # "xla" must key the program actually built)
+        tp_sig = (
+            f"tp={self.config.tp_degree}:"
+            f"tpn={self.config.tp_numerics}:"
+            if self.tp is not None else ""
+        )
         sig = (
             f"{kind}:bucket={bucket}:any_sample={any_sample}:"
-            f"dk={self.config.decode_kernel}:"
+            f"dk={self._decode_kernel}:{tp_sig}"
             f"code={self._adapter_code_fp}:"
             + _cc_mod.signature_str(aargs)
         )
@@ -782,7 +954,7 @@ class Engine:
         ))
         svc = (
             signature_str((
-                abstractify(self.adapter.weights),
+                abstractify(self._launch_weights()),
                 abstractify(self.pool.k),
             ))
             + f"|slots={cfg.max_batch_slots}|mml={cfg.max_model_len}"
@@ -791,7 +963,18 @@ class Engine:
             + f"|chunk={cfg.prefill_chunk_tokens}"
             + f"|pfx={int(cfg.enable_prefix_cache)}"
             + f"|spec={cfg.speculate_tokens}"
-            + f"|dk={cfg.decode_kernel}|kvq={cfg.kv_cache_dtype}"
+            # dk is the EFFECTIVE kernel (matches the per-program
+            # signatures): sharded engines configured "pallas" and
+            # "xla" build byte-identical program sets and must share
+            # one manifest; at tp=1 effective == configured, so every
+            # pre-existing single-chip service key is unchanged
+            + f"|dk={self._decode_kernel}|kvq={cfg.kv_cache_dtype}"
+            # tp= keys the service only when sharding is on, so every
+            # single-chip manifest written before this existed stays
+            # live; a sharded engine warm-restarts from its OWN tp=N
+            # manifest (docs/compilecache.md)
+            + (f"|tp={cfg.tp_degree}|tpn={cfg.tp_numerics}"
+               if self.tp is not None else "")
             + f"|code={self._adapter_code_fp}"
         )
         self._service_key = hashlib.sha256(svc.encode()).hexdigest()[:16]
@@ -907,6 +1090,7 @@ class Engine:
                 f'check_decode mode must be "warn" or "error", got '
                 f"{mode!r}"
             )
+        self._pin_adapter()
         cfg = self.config
         n = cfg.max_batch_slots
         params = pack_sampling_params(self.slots)
@@ -929,7 +1113,7 @@ class Engine:
                 )
                 variant = analysis.check(
                     self._decode_fn,
-                    self.adapter.weights, self.pool.k, self.pool.v,
+                    self._launch_weights(), self.pool.k, self.pool.v,
                     np.zeros(n, np.int32), np.zeros(n, np.int32),
                     np.zeros((n, cfg.pages_per_seq), np.int32),
                     np.zeros(n, bool),
@@ -978,6 +1162,7 @@ class Engine:
                 f'check_prefill mode must be "warn" or "error", got '
                 f"{mode!r}"
             )
+        self._pin_adapter()
         cfg = self.config
         bucket = cfg.prefill_buckets[0]
         m = self.metrics
@@ -998,7 +1183,7 @@ class Engine:
             for any_sample in (False, True):
                 merge(analysis.check(
                     self._prefill_ext_fn,
-                    self.adapter.weights, self.pool.k, self.pool.v,
+                    self._launch_weights(), self.pool.k, self.pool.v,
                     np.zeros(bucket, np.int32), np.int32(1), np.int32(0),
                     np.zeros(cfg.pages_per_seq, np.int32),
                     np.float32(1.0), np.int32(0), np.float32(1.0),
@@ -1047,6 +1232,7 @@ class Engine:
                 f'check_verify mode must be "warn" or "error", got '
                 f"{mode!r}"
             )
+        self._pin_adapter()
         cfg = self.config
         if cfg.speculate_tokens is None:
             raise RuntimeError(
@@ -1060,7 +1246,7 @@ class Engine:
         try:
             report = analysis.check(
                 self._verify_fn,
-                self.adapter.weights, self.pool.k, self.pool.v,
+                self._launch_weights(), self.pool.k, self.pool.v,
                 np.zeros((n, k + 1), np.int32), np.zeros(n, np.int32),
                 np.zeros(n, np.int32),
                 np.zeros((n, cfg.pages_per_seq), np.int32),
@@ -1086,6 +1272,34 @@ class Engine:
 
             warnings.warn(msg, stacklevel=2)
         return report
+
+    def _launch_weights(self):
+        """The weight tree every launch (and trace/abstraction site)
+        passes to the compiled programs: the engine's own mesh-placed
+        copy under TP, the adapter's live tree otherwise — so
+        ``adapter.refresh()`` keeps propagating to single-chip engines
+        while a sharded engine's placement can never leak through a
+        shared adapter."""
+        return (
+            self._tp_weights if self._tp_weights is not None
+            else self.adapter.weights
+        )
+
+    def _pin_adapter(self):
+        """Re-assert THIS engine's mutable adapter knobs before any
+        launch or trace. The traced bodies read ``adapter.tp_spec`` /
+        ``adapter.decode_kernel`` at TRACE time, and tracing is lazy
+        (first launch, or a mid-serving `_ensure_program` miss) — so a
+        shared adapter whose knobs a LATER engine build reassigned
+        would otherwise leak that engine's mesh/kernel into this one's
+        first trace (exact-mode constraints silently dropped, or a
+        single-chip program compiled against another engine's mesh).
+        Two attribute writes per launch; already-compiled programs
+        never re-read them."""
+        if hasattr(self.adapter, "decode_kernel"):
+            self.adapter.decode_kernel = self._decode_kernel
+        if hasattr(self.adapter, "tp_spec"):
+            self.adapter.tp_spec = self.tp
 
     def _next_key(self):
         self._key_counter += 1
@@ -1429,10 +1643,26 @@ class Engine:
             # stores (degradations are visible in the process-wide
             # paddle_tpu_kernels_fallbacks_total counter)
             "decode_kernel": cfg.decode_kernel,
+            # the path programs were actually built with (a sharded
+            # engine's "pallas"/"auto" resolves to the XLA gather path)
+            "decode_kernel_effective": self._decode_kernel,
+            # tensor parallelism: degree + mesh device ids, so /healthz
+            # and the fleet router can tell a 4-chip replica from a
+            # 1-chip one
+            "tp_degree": cfg.tp_degree,
+            "tp_numerics": (
+                cfg.tp_numerics if self.tp is not None else None
+            ),
+            "tp_devices": (
+                self.tp.device_ids if self.tp is not None else []
+            ),
             "kv_cache_dtype": cfg.kv_cache_dtype or str(
                 self.pool._dtype
             ),
             "kv_bytes_per_token": self.pool.bytes_per_token(),
+            "kv_bytes_per_token_per_chip": (
+                self.pool.bytes_per_token_per_chip()
+            ),
             "kv_utilization": util,
             "kv_active_utilization": util_active,
             "kv_reclaimable_blocks": reclaimable,
@@ -1580,6 +1810,7 @@ class Engine:
         return wd.watch(tag)
 
     def _prefill(self, req, tokens):
+        self._pin_adapter()
         faults.fire(
             "serving.step", phase="prefill", request_id=req.request_id,
         )
@@ -1604,7 +1835,7 @@ class Engine:
         ):
             try:
                 args = (
-                    self.adapter.weights, self.pool.k, self.pool.v,
+                    self._launch_weights(), self.pool.k, self.pool.v,
                     ids, np.int32(len(tokens)), table,
                     np.float32(p.temperature), np.int32(p.top_k),
                     np.float32(p.top_p), np.bool_(p.do_sample),
@@ -1717,6 +1948,7 @@ class Engine:
         Non-final chunks run the greedy-only variant regardless of the
         request's sampling params — their sampled token is discarded,
         so the vocab warp would be wasted compute."""
+        self._pin_adapter()
         faults.fire(
             "serving.step", phase="prefill", request_id=req.request_id,
         )
@@ -1739,7 +1971,7 @@ class Engine:
         ):
             try:
                 args = (
-                    self.adapter.weights, self.pool.k, self.pool.v,
+                    self._launch_weights(), self.pool.k, self.pool.v,
                     ids, np.int32(len(chunk)), np.int32(cache_len),
                     table,
                     np.float32(p.temperature), np.int32(p.top_k),
@@ -1775,6 +2007,7 @@ class Engine:
         """Copy-on-write one physical block (every layer's pages) so a
         prefill can diverge from a shared partial block without
         touching the original."""
+        self._pin_adapter()
         with span(
             "serving.cow", src=int(src), dst=int(dst),
         ), self._watch("serving.cow"), jit_events.watch(
@@ -1926,6 +2159,7 @@ class Engine:
         pages), so any active-mask subset yields the same tokens for its
         members as the full batch would — the property the poison-
         isolation bisection in _decode_subset relies on."""
+        self._pin_adapter()
         cfg = self.config
         n = cfg.max_batch_slots
         tokens = np.zeros(n, np.int32)
@@ -1952,7 +2186,7 @@ class Engine:
         ):
             try:
                 args = (
-                    self.adapter.weights, self.pool.k, self.pool.v,
+                    self._launch_weights(), self.pool.k, self.pool.v,
                     tokens, positions, tables, active,
                     params["temperature"], params["top_k"],
                     params["top_p"], params["do_sample"], key,
@@ -2102,6 +2336,7 @@ class Engine:
         host-side accept loop. Per-slot outputs are independent (same
         property as _launch_decode), so the poison-isolation bisection
         applies unchanged — re-launches reuse the same drafts."""
+        self._pin_adapter()
         cfg = self.config
         n, k = cfg.max_batch_slots, cfg.speculate_tokens
         tokens = np.zeros((n, k + 1), np.int32)
@@ -2131,7 +2366,7 @@ class Engine:
         ):
             try:
                 args = (
-                    self.adapter.weights, self.pool.k, self.pool.v,
+                    self._launch_weights(), self.pool.k, self.pool.v,
                     tokens, positions, draft_lens, tables, active,
                 )
                 if self._cc is not None:

@@ -259,6 +259,11 @@ def _register_view(fleet):
         reclaimable = MetricFamily(
             "paddle_tpu_fleet_replica_kv_reclaimable_blocks", "gauge",
         )
+        # tensor-parallel degree per replica: a router/dashboard must
+        # tell a 4-chip replica's capacity from a 1-chip one's
+        tp_deg = MetricFamily(
+            "paddle_tpu_fleet_replica_tp_degree", "gauge",
+        )
         for sup in fl.replicas:
             rl = {**label, "replica": sup.name}
             up.add(1.0 if sup.status == "healthy" else 0.0, rl)
@@ -270,7 +275,11 @@ def _register_view(fleet):
                 pfx_tokens.add(em.prefix_hit_tokens, rl)
                 pfill.add(em.prefill_tokens, rl)
                 reclaimable.add(em.kv_reclaimable_blocks, rl)
-        fams += [up, restarts, pfx_hits, pfx_tokens, pfill, reclaimable]
+                tp_deg.add(em.tp_degree, rl)
+        fams += [
+            up, restarts, pfx_hits, pfx_tokens, pfill, reclaimable,
+            tp_deg,
+        ]
         cfg, pooled = fl._slo_pool()
         if cfg is not None:
             # fleet-level burn from POOLED windows (the per-replica

@@ -97,6 +97,23 @@ class BlockManager:
                 self._free.append(b)
 
 
+def _zeros_factory(shape, dtype, sharding):
+    """Allocator for one pool plane. With a ``sharding`` the zeros
+    program is jitted with ``out_shardings`` so each chip materializes
+    ONLY its shard — a pool sized to N chips' combined KV budget must
+    never transiently exist whole on one chip (that transient is
+    exactly the single-chip RESOURCE_EXHAUSTED ceiling tensor
+    parallelism removes). One compile per plane shape; each call runs
+    the executable and returns a fresh buffer."""
+    if sharding is None:
+        return lambda: jnp.zeros(shape, dtype)
+    import jax
+
+    return jax.jit(
+        lambda: jnp.zeros(shape, dtype), out_shardings=sharding
+    )
+
+
 class KVPool:
     """The physical page pool: one (k, v) array pair per layer, each
     ``[num_kv_heads, num_blocks, block_size, head_dim]`` — the exact
@@ -114,7 +131,8 @@ class KVPool:
     head_dim 64), ~1.9x for bf16."""
 
     def __init__(self, num_layers, num_kv_heads, num_blocks, block_size,
-                 head_dim, dtype="float32", quant_dtype=None):
+                 head_dim, dtype="float32", quant_dtype=None,
+                 sharding=None, shard_degree=1):
         if quant_dtype not in (None, "int8"):
             raise ValueError(
                 f'KVPool quant_dtype must be None or "int8", got '
@@ -122,20 +140,25 @@ class KVPool:
             )
         shape = (num_kv_heads, num_blocks, block_size, head_dim)
         self.quant_dtype = quant_dtype
+        # tensor-parallel placement (serving.sharding): pages allocate
+        # DIRECTLY under the sharding — never whole on one chip first
         if quant_dtype == "int8":
             sshape = (num_kv_heads, num_blocks, block_size)
+            pages_z = _zeros_factory(shape, jnp.int8, sharding)
+            # zero scales: unwritten slots dequantize to exact 0,
+            # matching the float pool's zero init
+            scales_z = _zeros_factory(sshape, jnp.float32, sharding)
 
             def mk():
-                # zero scales: unwritten slots dequantize to exact 0,
-                # matching the float pool's zero init
-                return (jnp.zeros(shape, jnp.int8),
-                        jnp.zeros(sshape, jnp.float32))
+                return (pages_z(), scales_z())
 
             self._shapes = (shape, sshape)
             self._dtypes = (jnp.dtype(jnp.int8), jnp.dtype(jnp.float32))
         else:
+            pages_z = _zeros_factory(shape, dtype, sharding)
+
             def mk():
-                return jnp.zeros(shape, dtype)
+                return pages_z()
 
             self._shapes = (shape,)
             self._dtypes = (jnp.zeros((), dtype).dtype,)
@@ -146,6 +169,13 @@ class KVPool:
         self.num_blocks = int(num_blocks)
         self._shape = shape
         self._dtype = self._dtypes[0]
+        # logical-bytes / per-chip-bytes ratio when the kv-head dim is
+        # actually split (1 = replicated or unsharded)
+        self.shard_degree = int(shard_degree)
+        # measured eagerly while the fresh arrays are guaranteed live
+        # (see per_chip_nbytes: later reads would race TPU donation)
+        self._per_chip_nbytes = None
+        self.per_chip_nbytes()
 
     def _layer_leaves(self, entry):
         """The validated leaves of one per-layer entry: (pages,) for a
@@ -206,5 +236,44 @@ class KVPool:
 
     def bytes_per_token(self):
         """Cache bytes per token slot across all layers and kv heads —
-        the byte-budget figure the int8 mode halves."""
+        the byte-budget figure the int8 mode halves. LOGICAL total:
+        what the whole pool costs across every chip it spans."""
         return self.nbytes() / (self.num_blocks * self.block_size)
+
+    def per_chip_nbytes(self):
+        """Bytes the most-loaded single device actually holds, measured
+        ONCE from the real shards (``addressable_shards``) while the
+        freshly-allocated arrays are guaranteed live, then cached —
+        placement is static after build (the compiled steps pin their
+        out shardings), and reading shard buffers later would race the
+        donated pool on TPU: between a launch consuming the donated
+        arrays and ``rebind()``, ``self.k`` references deleted arrays,
+        and a concurrent ``health()`` probe touching their shards would
+        raise — flapping a perfectly healthy replica. An unsharded pool
+        reports its full size, a tp-sharded one ~1/tp of it."""
+        if self._per_chip_nbytes is None:
+            import jax
+
+            per: dict = {}
+            for a in jax.tree_util.tree_leaves((self.k, self.v)):
+                shards = getattr(a, "addressable_shards", None)
+                if shards:
+                    for s in shards:
+                        per[s.device.id] = (
+                            per.get(s.device.id, 0)
+                            + s.data.size * a.dtype.itemsize
+                        )
+                else:  # abstract value: fall back to the whole array
+                    per[None] = (
+                        per.get(None, 0) + a.size * a.dtype.itemsize
+                    )
+            self._per_chip_nbytes = max(per.values()) if per else 0
+        return self._per_chip_nbytes
+
+    def bytes_per_token_per_chip(self):
+        """Per-chip counterpart of :meth:`bytes_per_token` — the figure
+        tensor-parallel sharding cuts ~tp-fold (``Engine.health()``
+        exports both)."""
+        return self.per_chip_nbytes() / (
+            self.num_blocks * self.block_size
+        )

@@ -53,6 +53,7 @@ _EXPORT_KINDS = {
     "cow_copies": ("counter", "_total"),
     "queue_depth": ("gauge", ""),
     "num_running": ("gauge", ""),
+    "tp_degree": ("gauge", ""),
     "cache_utilization": ("gauge", ""),
     "kv_active_utilization": ("gauge", ""),
     "kv_reclaimable_blocks": ("gauge", ""),
@@ -185,6 +186,10 @@ class EngineMetrics:
         # gauges (updated by the engine each step)
         self.queue_depth = 0
         self.num_running = 0
+        # tensor-parallel degree of the engine this view belongs to
+        # (1 = single-chip; set at engine build, never changes) — lets
+        # dashboards tell a 4-chip replica's series from a 1-chip one's
+        self.tp_degree = 1
         self.cache_utilization = 0.0
         # KV pressure split: active excludes reclaimable-cached blocks
         # (retained prefix blocks nobody is running against) — shedding
@@ -263,6 +268,7 @@ class EngineMetrics:
             "last_error": self.last_error,
             "queue_depth": self.queue_depth,
             "num_running": self.num_running,
+            "tp_degree": self.tp_degree,
             "prefill_tokens": self.prefill_tokens,
             "decode_tokens": self.decode_tokens,
             "prefix_lookups": self.prefix_lookups,

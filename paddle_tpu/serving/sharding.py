@@ -1,0 +1,300 @@
+"""Tensor-parallel sharded serving: one engine, ``tp_degree`` chips.
+
+Serving has been single-chip end to end — one chip bounds model size,
+KV budget, and batch (the bench hits RESOURCE_EXHAUSTED at 747M
+params). This module lifts the ceiling the same way the training side
+does (``distributed.parallelize``): a 1 x tp device ``Mesh``, the
+col/row-wise Megatron plan applied to the adapter's weight pytree via
+``jax.sharding.NamedSharding``, and the paged KV pool sharded on its
+kv-head dimension over the same mesh. Every serving program
+(prefill / prefill_ext / decode / verify / cow) stays ONE single-launch
+SPMD program: sharding is expressed through the shardings of the traced
+bodies' inputs and outputs — GSPMD places the collectives — never
+through per-device python loops, so the engine's scheduler, compile
+probes, warmup manifest, and journal are untouched by the chip count.
+
+Partition plan (``SERVING_TP_PLAN`` — the serving-side instantiation of
+``distributed.parallelize``'s ColWiseParallel/RowWiseParallel markers
+over the adapter's raw weight dict):
+
+  * ``wq/wk/wv`` and ``wg/wu`` col-parallel: output (head / FFN) dim
+    sharded, so attention runs ``num_heads / tp`` heads per chip and
+    the SwiGLU intermediate lives sharded.
+  * ``wo/wd`` row-parallel: contraction dim sharded (the Megatron
+    pairing that keeps activation layout consistent).
+  * ``embed``/``norm``/``ln*``/``head`` replicated. A vocab-sharded LM
+    head would push the sampling warp (top-k/top-p over the full
+    vocab) cross-chip; logits stay replicated so sampling and the
+    argmax-based verify contract are untouched.
+  * KV pages ``[num_kv_heads, blocks, bs, d]`` sharded on dim 0 —
+    per-chip KV bytes drop ~tp-fold. GQA-aware: kv heads shard only
+    when ``tp`` divides ``num_kv_heads``; with ``num_kv_heads < tp``
+    the pool (and wk/wv) replicate instead — still correct, no KV
+    saving (documented in docs/serving.md).
+
+Determinism (``EngineConfig(tp_numerics=)``): a sharded CONTRACTION
+(the row-parallel matmuls) is computed as per-chip partial sums plus an
+all-reduce, whose cross-chip reduction order differs from the
+single-chip matmul by ~1 ulp — enough to flip a greedy argmax. The
+default ``"exact"`` mode therefore constrains both operands of the two
+row-parallel matmuls to replicated (an all-gather of the sharded
+weight) so every reduction runs whole on every chip: greedy AND
+sampled outputs are byte-identical to the unsharded engine, at the
+cost of weight-gather bandwidth per step. ``"fast"`` leaves GSPMD to
+the Megatron partial-sum + all-reduce — the production mode for real
+ICI, within ~1 ulp of the reference (docs/serving.md has the full
+caveat table). Everything else in the plan is reduction-free on the
+sharded axis (col-parallel matmuls contract over replicated dims,
+attention reduces within a head, page writes/gathers move bytes), so
+it is bit-exact in both modes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..distributed.parallelize import ColWiseParallel, RowWiseParallel
+
+__all__ = [
+    "TPSpec", "SERVING_TP_PLAN", "build_tp_mesh", "build_tp_spec",
+    "resolve_devices",
+]
+
+# per-weight-key plan over the adapter's raw weight dict (keys are the
+# LlamaServingAdapter layer-dict keys, not module paths). Keys absent
+# here (ln1/ln2/embed/norm/head) replicate.
+SERVING_TP_PLAN = {
+    "wq": ColWiseParallel(),
+    "wk": ColWiseParallel(),
+    "wv": ColWiseParallel(),
+    "wg": ColWiseParallel(),
+    "wu": ColWiseParallel(),
+    "wo": RowWiseParallel(),
+    "wd": RowWiseParallel(),
+}
+
+# the adapter weight-dict layer keys the plan is defined over — used to
+# recognize a shardable weight tree (anything else needs its own plan)
+_LAYER_KEYS = (
+    "ln1", "wq", "wk", "wv", "wo", "ln2", "wg", "wu", "wd",
+)
+
+
+def resolve_devices(devices, tp_degree):
+    """The explicit device list behind the mesh: ``devices`` may be
+    jax ``Device`` objects or integer device ids (picklable configs);
+    ``None`` takes the first ``tp_degree`` of ``jax.devices()``. Raises
+    ONE ValueError naming ``tp_degree``/``devices`` when the list
+    cannot cover the degree."""
+    import jax
+
+    avail = jax.devices()
+    if devices is None:
+        if len(avail) < tp_degree:
+            raise ValueError(
+                f"EngineConfig(tp_degree={tp_degree}) needs "
+                f"{tp_degree} devices but only {len(avail)} "
+                f"{avail[0].platform} device(s) are visible; pass "
+                f"devices= or lower tp_degree (CPU tests force more "
+                f"via --xla_force_host_platform_device_count)"
+            )
+        return list(avail[:tp_degree])
+    devices = list(devices)
+    if len(devices) != tp_degree:
+        # exact, not >=: silently truncating an over-long list would
+        # run the mesh on fewer chips than the operator pinned — the
+        # same silent-misplacement class the tp_degree=1 refusal guards
+        raise ValueError(
+            f"EngineConfig(devices=) has {len(devices)} entries but "
+            f"tp_degree={tp_degree} needs exactly {tp_degree} (the "
+            f"mesh's device list, nothing more)"
+        )
+    by_id = {d.id: d for d in avail}
+    out = []
+    for d in devices:
+        if isinstance(d, int):
+            if d not in by_id:
+                raise ValueError(
+                    f"EngineConfig(devices=) names device id {d} but "
+                    f"visible ids are {sorted(by_id)}"
+                )
+            out.append(by_id[d])
+        else:
+            if by_id.get(getattr(d, "id", None)) != d:
+                # e.g. a Device from another backend/process: placing
+                # on it dies as a bare AssertionError inside device_put
+                raise ValueError(
+                    f"EngineConfig(devices=) names device {d!r} which "
+                    f"is not among this process's visible devices "
+                    f"(ids {sorted(by_id)})"
+                )
+            out.append(d)
+    if len({d.id for d in out}) != len(out):
+        raise ValueError(
+            f"EngineConfig(devices=) repeats a device (ids "
+            f"{[d.id for d in out]}); a 1 x {tp_degree} mesh needs "
+            f"{tp_degree} DISTINCT devices"
+        )
+    return out
+
+
+def build_tp_mesh(devices):
+    """The 1 x tp serving mesh over an explicit device list: ``dp`` is
+    the (degenerate) replica axis — a Fleet scales replicas, the mesh
+    scales ONE replica — and ``tp`` is the axis every partition spec
+    references."""
+    from jax.sharding import Mesh
+
+    return Mesh(
+        np.asarray(devices, dtype=object).reshape(1, len(devices)),
+        ("dp", "tp"),
+    )
+
+
+def _plan_spec(plan, kv_sharded, key):
+    """PartitionSpec for one weight-dict key under the col/row plan."""
+    from jax.sharding import PartitionSpec as P
+
+    mark = plan.get(key)
+    if mark is None:
+        return P()
+    if key in ("wk", "wv") and not kv_sharded:
+        return P()  # GQA: fewer kv heads than chips -> replicate
+    if isinstance(mark, ColWiseParallel):
+        return P(None, "tp")
+    if isinstance(mark, RowWiseParallel):
+        return P("tp", None)
+    raise TypeError(
+        f"unknown TP plan marker {type(mark).__name__} for {key!r}"
+    )
+
+
+class TPSpec:
+    """Everything the engine and adapter need to run one replica as a
+    single SPMD program over ``tp_degree`` chips: the mesh, the
+    NamedSharding trees for the weight pytree and the KV pool, and the
+    numerics mode the adapter's row-parallel matmuls consult at trace
+    time (``serving.adapter._row_matmul``)."""
+
+    def __init__(self, mesh, tp_degree, numerics, kv_sharded, plan=None):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.mesh = mesh
+        self.tp_degree = int(tp_degree)
+        self.numerics = numerics
+        self.exact = numerics == "exact"
+        self.kv_sharded = bool(kv_sharded)
+        self.plan = dict(SERVING_TP_PLAN if plan is None else plan)
+        self.replicated = NamedSharding(mesh, P())
+        # KV pages [kv_heads, blocks, bs, d] / int8 scale planes
+        # [kv_heads, blocks, bs]: head dim sharded when GQA allows
+        pool_spec = P("tp") if self.kv_sharded else P()
+        self.pool_sharding = NamedSharding(mesh, pool_spec)
+
+    @property
+    def device_ids(self):
+        return [d.id for d in self.mesh.devices.flat]
+
+    def weight_shardings(self, weights):
+        """NamedSharding tree matching the adapter weight pytree."""
+        from jax.sharding import NamedSharding
+
+        named = lambda key: NamedSharding(
+            self.mesh, _plan_spec(self.plan, self.kv_sharded, key)
+        )
+        return {
+            "embed": self.replicated,
+            "norm": self.replicated,
+            "head": (
+                self.replicated if weights.get("head") is not None
+                else None
+            ),
+            "layers": [
+                {k: named(k) for k in layer}
+                for layer in weights["layers"]
+            ],
+        }
+
+    def shard_weights(self, weights):
+        """Place the weight pytree on the mesh per the plan (persistent
+        per-chip weight bytes drop for every sharded matrix)."""
+        import jax
+
+        return jax.tree_util.tree_map(
+            jax.device_put, weights, self.weight_shardings(weights),
+        )
+
+    def pool_out_shardings(self, pool):
+        """out_shardings tree pinning the traced bodies' returned pool
+        to the pool's placement — output sharding must round-trip
+        exactly or the next launch would miss the compiled program's
+        input layout and retrace."""
+        import jax
+
+        tree = jax.tree_util.tree_map(lambda a: a.sharding, pool.k)
+        return tree, jax.tree_util.tree_map(
+            lambda a: a.sharding, pool.v
+        )
+
+    def abstract(self, tree):
+        """``compilecache.abstractify`` with shardings attached: the
+        AOT path lowers from ShapeDtypeStructs, which carry no
+        placement unless told — these mirror the launch-site arrays
+        exactly, so the cached executable IS the lazy-path program."""
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(
+                a.shape, a.dtype, sharding=a.sharding
+            ),
+            tree,
+        )
+
+
+def build_tp_spec(adapter, config):
+    """Validate ``EngineConfig(tp_degree=, devices=, tp_numerics=)``
+    against the adapter and return the :class:`TPSpec` — or raise ONE
+    clear error naming the flag and the offending dimension (today a
+    bad degree surfaces as a deep XLA mesh error at first launch).
+    """
+    tp = int(config.tp_degree)
+    weights = getattr(adapter, "weights", None)
+    layers = weights.get("layers") if isinstance(weights, dict) else None
+    if (not layers or not isinstance(layers[0], dict)
+            or not all(k in layers[0] for k in _LAYER_KEYS)):
+        raise TypeError(
+            f"{type(adapter).__name__} does not expose the layered "
+            f"weight dict the serving TP plan shards "
+            f"({'/'.join(_LAYER_KEYS)} per layer), but EngineConfig("
+            f"tp_degree={tp}) needs an adapter it can partition"
+        )
+    head_dim = adapter.head_dim
+    num_heads = getattr(adapter, "num_heads", None)
+    if num_heads is None:
+        num_heads = layers[0]["wq"].shape[1] // head_dim
+    num_kv_heads = adapter.num_kv_heads
+    ffn = layers[0]["wg"].shape[1]
+    if num_heads % tp:
+        raise ValueError(
+            f"EngineConfig(tp_degree={tp}) does not divide the "
+            f"model's num_attention_heads={num_heads}: attention "
+            f"heads shard over the tp axis, so tp_degree must divide "
+            f"them"
+        )
+    if ffn % tp:
+        raise ValueError(
+            f"EngineConfig(tp_degree={tp}) does not divide the "
+            f"model's FFN intermediate_size={ffn}: gate/up/down "
+            f"shard over the tp axis, so tp_degree must divide it"
+        )
+    kv_sharded = num_kv_heads >= tp
+    if kv_sharded and num_kv_heads % tp:
+        raise ValueError(
+            f"EngineConfig(tp_degree={tp}) does not divide the "
+            f"model's num_key_value_heads={num_kv_heads}: KV heads "
+            f"shard over the tp axis when num_kv_heads >= tp_degree "
+            f"(use a degree that divides them, or one larger than "
+            f"num_kv_heads to replicate the KV pool)"
+        )
+    devices = resolve_devices(config.devices, tp)
+    mesh = build_tp_mesh(devices)
+    return TPSpec(mesh, tp, config.tp_numerics, kv_sharded)

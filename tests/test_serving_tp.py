@@ -1,0 +1,505 @@
+"""Tensor-parallel sharded serving (serving/sharding.py).
+
+The acceptance criteria, asserted directly on the forced multi-device
+CPU backend (conftest pins 8 host devices):
+
+  * a ``tp_degree=2`` engine's outputs on the 32-request mixed workload
+    (prefix cache + chunked prefill + speculation enabled) are
+    BYTE-identical to the unsharded engine's, with the compile-count
+    probes showing the same program-family counts (tp=4 in the slow
+    lane);
+  * per-chip KV pool bytes drop ~tp-fold (measured from the real
+    shards, <= ~30% of the single-chip pool at tp=4);
+  * bad configs raise ONE clear error naming the flag and the
+    offending dimension; ``decode_kernel="pallas"`` degrades (warned +
+    counted, reason="sharding"), never fatal;
+  * a warm restart from a ``tp=``-keyed compile cache replays zero
+    fresh traces in a PRISTINE process, and a Fleet kill-mid-decode
+    failover over sharded replicas recovers bit-identically (both slow
+    lane).
+
+The subprocess fixture (``device_fixture.run_with_device_count``) gives
+cases that need a device count OTHER than conftest's 8 — the
+single-device validation probe, the cross-process warm restart — a
+fresh interpreter, since the jax device count is fixed at init.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from device_fixture import run_with_device_count
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.resilience import FaultSpec, faults
+from paddle_tpu.serving import (
+    Engine,
+    EngineConfig,
+    Fleet,
+    FleetConfig,
+    SamplingParams,
+)
+
+COMPILE_COUNTERS = (
+    "prefill_compiles", "prefill_ext_compiles", "decode_compiles",
+    "verify_compiles", "cow_compiles",
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def _tp_engine_config(tp, **kw):
+    """The full-feature config of the acceptance workload: prefix
+    cache + chunked prefill + speculation, single prefill bucket to
+    keep the program family compile-lean."""
+    base = dict(
+        max_batch_slots=4, max_model_len=64, page_size=4,
+        num_blocks=56, prefill_buckets=[64], enable_prefix_cache=True,
+        prefill_chunk_tokens=8, max_prefill_chunks_per_step=2,
+        speculate_tokens=3, tp_degree=tp, seed=0,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _tp_workload(n_req=32):
+    """32 mixed requests: half share a prompt prefix (prefix-cache
+    hits + one COW), lengths heterogeneous, every 4th sampled (the
+    sampled program variants join the family; exact-mode TP keeps even
+    those byte-identical since the logits feeding the warp are)."""
+    rng = np.random.default_rng(7)
+    base = rng.integers(1, 128, 12).tolist()
+    prompts, params = [], []
+    for i in range(n_req):
+        if i % 2 == 0:
+            p = (base[: int(rng.integers(6, 13))]
+                 + rng.integers(1, 128, int(rng.integers(2, 6))).tolist())
+        else:
+            p = rng.integers(1, 128, int(rng.integers(4, 15))).tolist()
+        prompts.append(p)
+        if i % 4 == 3:
+            params.append(SamplingParams(
+                max_new_tokens=int(rng.integers(4, 10)), do_sample=True,
+                temperature=0.8, top_k=12, top_p=0.9,
+            ))
+        else:
+            params.append(SamplingParams(
+                max_new_tokens=int(rng.integers(4, 12)),
+            ))
+    return prompts, params
+
+
+@pytest.fixture(scope="module")
+def parity_run(model):
+    """One shared build+run of the unsharded reference and the tp=2
+    engine over the acceptance workload (the expensive part — every
+    tier-1 assertion reads from here)."""
+    prompts, params = _tp_workload()
+    ref = Engine(model, _tp_engine_config(1))
+    ref_outs = ref.generate(prompts, params)
+    tp2 = Engine(model, _tp_engine_config(2))
+    tp2_outs = tp2.generate(prompts, params)
+    return {
+        "prompts": prompts, "params": params,
+        "ref": ref, "tp2": tp2,
+        "ref_outs": ref_outs, "tp2_outs": tp2_outs,
+    }
+
+
+class TestTPParity:
+    def test_tp2_byte_parity_mixed_workload(self, parity_run):
+        """tp=2 outputs byte-identical to the unsharded engine on the
+        mixed workload — greedy by contract, sampled too (exact-mode
+        numerics keep the logits feeding the warp bit-equal)."""
+        params = parity_run["params"]
+        assert any(p.do_sample for p in params)       # actually mixed
+        assert any(not p.do_sample for p in params)
+        for p, a, b in zip(
+            params, parity_run["ref_outs"], parity_run["tp2_outs"]
+        ):
+            assert a.token_ids == b.token_ids, (
+                f"sampled={p.do_sample}"
+            )
+            assert a.finish_reason == b.finish_reason
+
+    def test_tp2_same_program_family_counts(self, parity_run):
+        """The sharded engine compiles the SAME program family — one
+        SPMD program per (kind, bucket, variant), no per-device
+        anything (the compile counters bump inside the traced
+        bodies)."""
+        ref_m = parity_run["ref"].metrics
+        tp_m = parity_run["tp2"].metrics
+        for c in COMPILE_COUNTERS:
+            assert getattr(tp_m, c) == getattr(ref_m, c), c
+        assert tp_m.decode_compiles >= 1
+        assert tp_m.verify_compiles == 1
+        # the workload actually exercised the feature set
+        assert tp_m.prefix_hits > 0
+        assert tp_m.prefill_chunks > 0
+        assert tp_m.spec_accepted >= 0
+
+    def test_tp2_per_chip_kv_and_health(self, parity_run):
+        ref, tp2 = parity_run["ref"], parity_run["tp2"]
+        # the pool's head dim is sharded over 2 chips: per-chip bytes
+        # halve, measured from the REAL shards
+        assert tp2.pool.shard_degree == 2
+        assert tp2.pool.bytes_per_token() == ref.pool.bytes_per_token()
+        assert (tp2.pool.bytes_per_token_per_chip()
+                == pytest.approx(ref.pool.bytes_per_token() / 2))
+        h = tp2.health()
+        assert h["tp_degree"] == 2
+        assert len(h["tp_devices"]) == 2
+        assert h["tp_numerics"] == "exact"
+        assert (h["kv_bytes_per_token_per_chip"]
+                == pytest.approx(h["kv_bytes_per_token"] / 2))
+        h1 = ref.health()
+        assert h1["tp_degree"] == 1 and h1["tp_devices"] == []
+
+    def test_tp_degree_gauge_exported(self, parity_run):
+        from paddle_tpu.observability import get_registry
+
+        text = get_registry().render_prometheus()
+        eid = parity_run["tp2"].engine_id
+        assert (f'paddle_tpu_serving_tp_degree{{engine="{eid}"}} 2'
+                in text)
+
+
+class TestTPKVPool:
+    def test_tp4_per_chip_kv_bytes(self, model):
+        """The headline memory claim WITHOUT traffic (engine build
+        places the pool, nothing compiles): per-chip KV bytes at tp=4
+        are <= ~30% of the single-chip pool for the same config."""
+        single = Engine(model, _tp_engine_config(1))
+        tp4 = Engine(model, _tp_engine_config(4))
+        assert tp4.pool.shard_degree == 4
+        per_chip = tp4.pool.bytes_per_token_per_chip()
+        assert per_chip <= 0.30 * single.pool.bytes_per_token()
+        assert len(tp4.health()["tp_devices"]) == 4
+
+    def test_gqa_kv_replicates_when_fewer_heads_than_chips(self):
+        """num_kv_heads < tp_degree: the pool (and wk/wv) replicate —
+        correct, explicitly no KV saving — while attention heads still
+        shard."""
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny(
+            num_key_value_heads=2,
+        ))
+        cfg = EngineConfig(
+            max_batch_slots=2, max_model_len=16, page_size=4,
+            prefill_buckets=[16], tp_degree=4, seed=0,
+        )
+        eng = Engine(model, cfg)
+        assert eng.pool.shard_degree == 1        # replicated
+        assert (eng.pool.bytes_per_token_per_chip()
+                == eng.pool.bytes_per_token())
+        # and the replicated-KV math is still byte-exact vs unsharded
+        ref = Engine(model, EngineConfig(
+            max_batch_slots=2, max_model_len=16, page_size=4,
+            prefill_buckets=[16], seed=0,
+        ))
+        prompts = [[3, 5, 7], [11, 2, 9, 4]]
+        sp = SamplingParams(max_new_tokens=4)
+        want = [o.token_ids for o in ref.generate(prompts, sp)]
+        got = [o.token_ids for o in eng.generate(prompts, sp)]
+        assert got == want
+
+
+class TestAdapterReuse:
+    def test_shared_adapter_does_not_leak_mesh_placement(self, model):
+        """A pass-through adapter shared across engines: building a
+        sharded engine must not commit the ADAPTER's weight tree to
+        its mesh (the engine holds its own placed copy), so a
+        single-chip engine built over the same adapter afterwards
+        still runs — and matches a fresh reference byte-for-byte."""
+        from paddle_tpu.serving import LlamaServingAdapter
+
+        adapter = LlamaServingAdapter(model)
+        kw = dict(
+            max_batch_slots=2, max_model_len=32, page_size=4,
+            prefill_buckets=[32], seed=0,
+        )
+        tp2 = Engine(adapter, EngineConfig(tp_degree=2, **kw))
+        # the shared tree is untouched by the sharded build
+        assert adapter.weights["embed"] is not (
+            tp2._launch_weights()["embed"]
+        )
+        eng1 = Engine(adapter, EngineConfig(**kw))
+        # eng1's build reset the shared adapter's knobs ...
+        assert adapter.tp_spec is None
+        prompts = [[3, 5, 7], [11, 2, 9, 4]]
+        sp = SamplingParams(max_new_tokens=4)
+        got = [o.token_ids for o in eng1.generate(prompts, sp)]
+        ref = Engine(model, EngineConfig(**kw))
+        want = [o.token_ids for o in ref.generate(prompts, sp)]
+        assert got == want
+        # ... but the sharded engine re-pins them per launch, so its
+        # FIRST (lazy) traces — which happen here, after the reset —
+        # still compile with its own spec and stay byte-identical
+        assert [
+            o.token_ids for o in tp2.generate(prompts, sp)
+        ] == want
+        assert adapter.tp_spec is tp2.tp
+        # and interleaving back: eng1's launches re-pin None again
+        assert [
+            o.token_ids for o in eng1.generate(prompts, sp)
+        ] == want
+        assert adapter.tp_spec is None
+
+
+class TestTPInt8Pool:
+    @pytest.mark.slow
+    def test_int8_pool_shards_and_stays_parity(self, model):
+        """The two byte-cut axes compose: an int8 pool under tp=2
+        halves per-chip bytes AGAIN (pages and scale planes both shard
+        on the head dim), and exact-mode outputs match the unsharded
+        int8 engine byte-for-byte (both sides share the quantize-on-
+        write values, so the int8-vs-float tolerance caveat is
+        orthogonal to sharding)."""
+        kw = dict(
+            max_batch_slots=2, max_model_len=32, page_size=4,
+            prefill_buckets=[32], seed=0,
+        )
+        rng = np.random.default_rng(1)
+        prompts = [
+            rng.integers(1, 128, int(n)).tolist() for n in (4, 9, 6)
+        ]
+        sp = SamplingParams(max_new_tokens=5)
+        ref = Engine(model, EngineConfig(kv_cache_dtype="int8", **kw))
+        want = [o.token_ids for o in ref.generate(prompts, sp)]
+        tp2 = Engine(model, EngineConfig(
+            kv_cache_dtype="int8", tp_degree=2, **kw,
+        ))
+        got = [o.token_ids for o in tp2.generate(prompts, sp)]
+        assert got == want
+        assert tp2.pool.shard_degree == 2
+        assert (tp2.pool.bytes_per_token_per_chip()
+                == pytest.approx(ref.pool.bytes_per_token() / 2))
+
+
+class TestTPValidation:
+    def test_heads_not_dividing(self, model):
+        with pytest.raises(ValueError, match=r"tp_degree=3.*heads=4"):
+            Engine(model, _tp_engine_config(3))
+
+    def test_kv_heads_not_dividing(self):
+        paddle.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny(
+            hidden_size=48, num_attention_heads=6,
+            num_key_value_heads=3,
+        ))
+        with pytest.raises(
+            ValueError, match=r"tp_degree=2.*num_key_value_heads=3"
+        ):
+            Engine(m, _tp_engine_config(2))
+
+    def test_ffn_not_dividing(self):
+        paddle.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny(intermediate_size=126))
+        with pytest.raises(
+            ValueError, match=r"tp_degree=4.*intermediate_size=126"
+        ):
+            Engine(m, _tp_engine_config(4))
+
+    def test_devices_shorter_than_degree(self):
+        with pytest.raises(
+            ValueError, match=r"devices=.*1 entries.*tp_degree=2"
+        ):
+            EngineConfig(tp_degree=2, devices=[0])
+
+    def test_tp_numerics_validated(self):
+        with pytest.raises(ValueError, match="tp_numerics"):
+            EngineConfig(tp_degree=2, tp_numerics="approximate")
+
+    def test_duplicate_devices_refused(self, model):
+        with pytest.raises(ValueError, match=r"repeats a device"):
+            Engine(model, _tp_engine_config(2, devices=[0, 0]))
+
+    def test_overlong_devices_list_refused(self, model):
+        """devices= longer than the degree is refused, not silently
+        truncated — the operator pinned MORE chips than the mesh."""
+        with pytest.raises(ValueError, match=r"needs exactly 2"):
+            Engine(model, _tp_engine_config(2, devices=[0, 1, 2]))
+
+    def test_devices_without_tp_refused(self):
+        """devices= with tp_degree=1 is refused, not silently ignored
+        — an operator pinning chips must not get default placement."""
+        with pytest.raises(ValueError, match=r"devices=.*tp_degree"):
+            EngineConfig(devices=[0])
+
+    def test_single_device_process_raises_clean(self):
+        """Subprocess fixture (fresh interpreter, ONE visible device):
+        tp_degree=2 must raise the named ValueError, not a deep XLA
+        mesh failure."""
+        res = run_with_device_count(
+            1, "test_serving_tp:_single_device_probe"
+        )
+        assert res["devices"] == 1
+        assert res["error"] is not None
+        assert "tp_degree=2" in res["error"]
+        assert "1" in res["error"]
+
+
+class TestTPPallasDegradation:
+    def test_explicit_pallas_degrades_counted(self, model):
+        from paddle_tpu.kernels.pallas._compat import fallbacks_total
+
+        before = fallbacks_total()
+        with pytest.warns(UserWarning, match="sharding"):
+            eng = Engine(model, _tp_engine_config(
+                2, decode_kernel="pallas",
+            ))
+        assert fallbacks_total() == before + 1
+        h = eng.health()
+        assert h["decode_kernel"] == "pallas"        # what was asked
+        assert h["decode_kernel_effective"] == "xla"  # what runs
+        # the counter carries reason="sharding"
+        from paddle_tpu.observability import get_registry
+
+        assert ('paddle_tpu_kernels_fallbacks_total{'
+                'kernel="paged_attention",reason="sharding"}'
+                ) in get_registry().render_prometheus().replace(
+                    '", reason', '",reason')
+
+    def test_auto_resolves_silently(self, model):
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            eng = Engine(model, _tp_engine_config(2))
+        assert eng.health()["decode_kernel_effective"] == "xla"
+
+
+# -- subprocess payloads (imported by device_fixture in a fresh
+#    interpreter; must stay JSON-in/JSON-out) ----------------------------
+def _single_device_probe():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import Engine, EngineConfig
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    try:
+        Engine(model, EngineConfig(
+            max_batch_slots=2, max_model_len=16, page_size=4,
+            prefill_buckets=[16], tp_degree=2,
+        ))
+    except ValueError as e:
+        return {"devices": len(jax.devices()), "error": str(e)}
+    return {"devices": len(jax.devices()), "error": None}
+
+
+def _tp_cache_run(cache_dir, tp):
+    """Build the tp-sharded full-feature engine against ``cache_dir``,
+    run the acceptance workload, return outputs + fresh-trace count.
+    Run twice in pristine processes: the second MUST replay the
+    ``tp=``-keyed manifest with zero fresh traces."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import Engine
+    from test_serving_tp import (
+        COMPILE_COUNTERS, _tp_engine_config, _tp_workload,
+    )
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    eng = Engine(model, _tp_engine_config(tp, compile_cache=cache_dir))
+    prompts, params = _tp_workload()
+    outs = eng.generate(prompts, params)
+    return {
+        "tokens": [o.token_ids for o in outs],
+        "fresh_traces": sum(
+            getattr(eng.metrics, c) for c in COMPILE_COUNTERS
+        ),
+    }
+
+
+@pytest.mark.slow
+class TestTPSlow:
+    def test_tp4_byte_parity_mixed_workload(self, model, parity_run):
+        """The tp=4 lane of the acceptance criterion: same workload,
+        same byte-parity and program-family counts."""
+        prompts, params = (
+            parity_run["prompts"], parity_run["params"],
+        )
+        tp4 = Engine(model, _tp_engine_config(4))
+        outs = tp4.generate(prompts, params)
+        for a, b in zip(parity_run["ref_outs"], outs):
+            assert a.token_ids == b.token_ids
+        ref_m = parity_run["ref"].metrics
+        for c in COMPILE_COUNTERS:
+            assert getattr(tp4.metrics, c) == getattr(ref_m, c), c
+
+    def test_tp2_warm_restart_zero_trace_cross_process(
+        self, tmp_path, parity_run
+    ):
+        """Cold build in one pristine process, warm restart in a
+        second: the tp=2 service key replays the whole enlarged
+        program family from disk — zero fresh traces — and the
+        outputs stay byte-identical (to the cold run AND the in-
+        process unsharded reference)."""
+        cache = str(tmp_path / "cc")
+        cold = run_with_device_count(
+            8, "test_serving_tp:_tp_cache_run", cache, 2,
+        )
+        assert cold["fresh_traces"] > 0
+        warm = run_with_device_count(
+            8, "test_serving_tp:_tp_cache_run", cache, 2,
+        )
+        assert warm["fresh_traces"] == 0
+        assert warm["tokens"] == cold["tokens"]
+        assert warm["tokens"] == [
+            o.token_ids for o in parity_run["ref_outs"]
+        ]
+
+    def test_fleet_failover_over_sharded_replicas(self, model):
+        """Kill one tp=2 replica mid-decode: the fleet re-enqueues its
+        in-flight work on the surviving SHARDED replica and greedy
+        outputs stay token-for-token identical to an uninterrupted
+        unsharded engine, with failovers_total == 1."""
+        rng = np.random.default_rng(42)
+        prompts = [
+            rng.integers(1, 128, int(n)).tolist()
+            for n in rng.choice([3, 5, 7, 9], 16)
+        ]
+        params = SamplingParams(max_new_tokens=8)
+        fleet = Fleet(
+            model, _tp_engine_config(2),
+            FleetConfig(num_replicas=2, analysis_check=None),
+        )
+        fleet.generate(prompts, params)   # warm both replicas
+        for name in ("r0", "r1"):
+            eng = fleet.replica(name).engine
+            assert eng.health()["tp_degree"] == 2
+        spec = FaultSpec(
+            RuntimeError("replica torn"),
+            when=lambda c: (c.get("phase") == "step"
+                            and c.get("replica") == "r0"),
+            at=4,
+        )
+        with faults.inject({"serving.replica": spec}) as inj:
+            outs = fleet.generate(prompts, params)
+        assert inj.fired == {"serving.replica": 1}
+        oracle = Engine(model, _tp_engine_config(1))
+        ref = oracle.generate(prompts, params)
+        for got, want in zip(outs, ref):
+            assert got.token_ids == want.token_ids
+        assert fleet.metrics.failovers == 1
+        # the per-replica fleet view carries the degree
+        from paddle_tpu.observability import get_registry
+
+        text = get_registry().render_prometheus()
+        assert "paddle_tpu_fleet_replica_tp_degree" in text
+        # let the killed replica's background restart settle so its
+        # thread does not outlive the test
+        sup = fleet.replica("r0")
+        deadline = time.time() + 30
+        while (sup is not None and sup.status == "quarantined"
+               and time.time() < deadline):
+            sup.join_restart(0.5)
+            fleet.step()
