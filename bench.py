@@ -825,6 +825,125 @@ def bench_serving(paddle, on_tpu):
     return tps
 
 
+def bench_server(paddle, on_tpu):
+    """HTTP front door overhead (server row): the SAME mixed workload
+    timed in-process (``engine.generate``) and as open-loop concurrent
+    ``POST /v1/completions`` arrivals against a :class:`serving.Server`
+    fronting the same engine. ``serving_http_tokens_per_s`` is
+    end-to-end generated tokens/s through the wire (admission, QoS
+    accounting, SSE-less blocking responses, JSON marshalling);
+    ``serving_http_overhead_pct`` is the floor-to-floor cost of the
+    HTTP layer over the in-process call (the journal row's interleaved
+    floor_pair discipline — the driver thread only steps while HTTP
+    requests are in flight, so the in-process passes time the bare
+    engine)."""
+    import http.client
+    import threading
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import Engine, EngineConfig, SamplingParams
+    from paddle_tpu.serving.server import Server
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=12, num_attention_heads=16,
+        max_position_embeddings=2048,
+    ) if on_tpu else LlamaConfig.tiny()
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    n_req, slots, mml = (16, 8, 256) if on_tpu else (8, 4, 64)
+    engine = Engine(model, EngineConfig(
+        max_batch_slots=slots, max_model_len=mml,
+        page_size=16 if on_tpu else 8,
+    ))
+    rng = np.random.RandomState(0)
+    prompts = [
+        rng.randint(1, cfg.vocab_size,
+                    int(rng.randint(4, mml // 4))).tolist()
+        for _ in range(n_req)
+    ]
+    n_new = mml // 8
+    params = SamplingParams(max_new_tokens=n_new)
+    srv = Server(engine, port=0)
+
+    def http_pass():
+        total = [0]
+        lock = threading.Lock()
+
+        def one(prompt):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", srv.port, timeout=600,
+            )
+            try:
+                conn.request(
+                    "POST", "/v1/completions",
+                    body=json.dumps({
+                        "prompt": prompt, "max_new_tokens": n_new,
+                    }).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                body = json.loads(resp.read())
+                assert resp.status == 200, body
+                with lock:
+                    total[0] += body["usage"]["completion_tokens"]
+            finally:
+                conn.close()
+
+        threads = [
+            threading.Thread(target=one, args=(p,)) for p in prompts
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0, total[0]
+
+    def inproc_pass():
+        t0 = time.perf_counter()
+        outs = engine.generate(prompts, params)
+        dt = time.perf_counter() - t0
+        return dt, sum(len(o.token_ids) for o in outs)
+
+    try:
+        inproc_pass()   # warm programs
+        http_pass()     # warm the wire path (handler threads, parser)
+        dt_in = dt_http = None
+        toks_http = 0
+        for i in range(8 if on_tpu else 12):
+            order = ("in", "http") if i % 2 == 0 else ("http", "in")
+            for which in order:
+                if which == "in":
+                    dt, _ = inproc_pass()
+                    dt_in = dt if dt_in is None else min(dt_in, dt)
+                else:
+                    dt, toks = http_pass()
+                    if dt_http is None or dt < dt_http:
+                        dt_http, toks_http = dt, toks
+        overhead_pct = (dt_http - dt_in) / dt_in * 100.0
+        tps = toks_http / dt_http
+        m = srv.metrics
+        log(f"[server] http front door: {tps:,.0f} tokens/s "
+            f"({dt_http:.3f}s vs {dt_in:.3f}s in-process -> "
+            f"{overhead_pct:+.2f}%; {m.requests} requests, "
+            f"{m.responses['2xx']} 2xx)")
+        print(json.dumps({
+            "metric": "serving_http_tokens_per_s",
+            "value": round(tps, 1),
+            "unit": "tokens/s",
+        }))
+        print(json.dumps({
+            "metric": "serving_http_overhead_pct",
+            "value": round(overhead_pct, 2),
+            "unit": "percent",
+        }))
+    finally:
+        srv.close()
+
+
 def bench_fleet(paddle, on_tpu):
     """Replica-failover recovery (fleet row): ``fleet_failover_ms`` is
     the kill-to-first-recovered-token wall clock — an injected
@@ -1331,6 +1450,7 @@ ROWS = {
     "llama": lambda p, tpu, peak: bench_llama(p, tpu, peak),
     "decode": lambda p, tpu, peak: bench_decode(p, tpu),
     "serving": lambda p, tpu, peak: bench_serving(p, tpu),
+    "server": lambda p, tpu, peak: bench_server(p, tpu),
     "fleet": lambda p, tpu, peak: bench_fleet(p, tpu),
     "moe": lambda p, tpu, peak: bench_moe(p, tpu, peak),
     "kernels": lambda p, tpu, peak: bench_kernels(p, tpu, peak),
@@ -1434,7 +1554,8 @@ def main():
                     pass
             return r.returncode
 
-        for name in ("decode", "serving", "fleet", "compilecache",
+        for name in ("decode", "serving", "server", "fleet",
+                     "compilecache",
                      "resilience", "train_resume", "analysis",
                      "observability", "kernels", "moe", "resnet",
                      "dit"):

@@ -28,7 +28,7 @@ from . import metrics as _metrics
 __all__ = [
     "ScrapeServer", "start_scrape_server",
     "register_health_provider", "unregister_health_provider",
-    "health_snapshot",
+    "health_snapshot", "ThreadedHTTPHost", "ObservabilityHandler",
 ]
 
 _providers_lock = threading.Lock()
@@ -75,35 +75,53 @@ def health_snapshot():
     return out
 
 
-class _Handler(BaseHTTPRequestHandler):
+class ObservabilityHandler(BaseHTTPRequestHandler):
+    """Base request handler carrying the ``/metrics`` + ``/healthz``
+    routes. The API front door (``serving/server.py``) subclasses this
+    to co-host the observability endpoints next to the inference API
+    without re-implementing the exporter degradation contract."""
+
     def log_message(self, fmt, *args):  # quiet: CI logs, not access logs
         return
 
-    def _send(self, code, body, ctype):
-        data = body.encode()
+    def _send(self, code, body, ctype, headers=None):
+        data = body if isinstance(body, bytes) else body.encode()
         self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
-    def do_GET(self):
+    def _serve_observability(self, path):
+        """Serve ``/metrics`` / ``/healthz``; return False for other
+        paths (a subclass routes those itself)."""
         from ..resilience import faults
 
+        if path == "/metrics":
+            faults.fire("obs.export", what="scrape", path=path)
+            registry = (
+                getattr(self.server, "registry", None)
+                or _metrics.get_registry()
+            )
+            body = registry.render_prometheus()
+            self._send(
+                200, body, "text/plain; version=0.0.4; charset=utf-8"
+            )
+        elif path == "/healthz":
+            faults.fire("obs.export", what="healthz", path=path)
+            snap = health_snapshot()
+            code = 200 if snap["status"] == "ok" else 503
+            self._send(code, json.dumps(snap), "application/json")
+        else:
+            return False
+        return True
+
+    def do_GET(self):
         path = self.path.split("?", 1)[0]
         try:
-            if path == "/metrics":
-                faults.fire("obs.export", what="scrape", path=path)
-                body = self.server.registry.render_prometheus()
-                self._send(
-                    200, body, "text/plain; version=0.0.4; charset=utf-8"
-                )
-            elif path == "/healthz":
-                faults.fire("obs.export", what="healthz", path=path)
-                snap = health_snapshot()
-                code = 200 if snap["status"] == "ok" else 503
-                self._send(code, json.dumps(snap), "application/json")
-            else:
+            if not self._serve_observability(path):
                 self._send(404, "not found\n", "text/plain")
         except Exception as e:
             # exporter degradation contract: warn + 500, never propagate
@@ -117,19 +135,29 @@ class _Handler(BaseHTTPRequestHandler):
                 pass  # peer already gone; nothing left to degrade to
 
 
-class ScrapeServer:
-    """Handle to the running endpoint (``.port``, ``.url``,
-    ``.close()``)."""
+class ThreadedHTTPHost:
+    """Shared ``ThreadingHTTPServer``-on-a-daemon-thread setup: bind
+    (``port=0`` picks a free port — read ``.port``), attach arbitrary
+    attributes to the httpd for handlers to reach via ``self.server``,
+    and serve until ``close()``. ``ScrapeServer`` and the serving
+    front door both build on this."""
 
-    def __init__(self, host="127.0.0.1", port=0, registry=None):
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+    thread_name = "paddle_tpu-http"
+    handler_cls = ObservabilityHandler
+
+    def __init__(self, host="127.0.0.1", port=0, handler_cls=None,
+                 **server_attrs):
+        self._httpd = ThreadingHTTPServer(
+            (host, port), handler_cls or self.handler_cls
+        )
         self._httpd.daemon_threads = True
-        self._httpd.registry = registry or _metrics.get_registry()
+        for k, v in server_attrs.items():
+            setattr(self._httpd, k, v)
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
-            name="paddle_tpu-scrape",
+            name=self.thread_name,
         )
         self._thread.start()
 
@@ -147,6 +175,19 @@ class ScrapeServer:
     def __exit__(self, *exc):
         self.close()
         return False
+
+
+class ScrapeServer(ThreadedHTTPHost):
+    """Handle to the running endpoint (``.port``, ``.url``,
+    ``.close()``)."""
+
+    thread_name = "paddle_tpu-scrape"
+
+    def __init__(self, host="127.0.0.1", port=0, registry=None):
+        super().__init__(
+            host=host, port=port,
+            registry=registry or _metrics.get_registry(),
+        )
 
 
 def start_scrape_server(port=0, host="127.0.0.1", registry=None):

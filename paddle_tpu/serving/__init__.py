@@ -30,6 +30,13 @@ from .journal import Journal, ReplayEntry
 from .kv_cache import BlockManager, KVPool
 from .metrics import EngineMetrics
 from .prefix_cache import PrefixCache, PrefixMatch
+from .qos import (
+    QoS,
+    QoSConfig,
+    QoSRejection,
+    TenantPolicy,
+    UnknownTenantError,
+)
 from .request import (
     Request,
     RequestOutput,
@@ -37,6 +44,7 @@ from .request import (
     RequestTimeline,
     SamplingParams,
 )
+from .server import Server, serve
 from .sharding import TPSpec, build_tp_mesh
 from .supervisor import ReplicaSupervisor
 
@@ -48,4 +56,6 @@ __all__ = [
     "PrefixCache", "PrefixMatch", "Journal", "ReplayEntry", "AccessLog",
     "Fleet", "FleetConfig", "FleetMetrics", "FleetRequest",
     "NoReplicaError", "ReplicaSupervisor", "TPSpec", "build_tp_mesh",
+    "Server", "serve", "QoS", "QoSConfig", "QoSRejection",
+    "TenantPolicy", "UnknownTenantError",
 ]

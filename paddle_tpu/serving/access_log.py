@@ -242,6 +242,12 @@ def record_finish(req, latency=None, slo=None, access_log=None,
         "output_tokens": n_out,
         "error": req.error,
     }
+    # tenant attribution rides the Request itself (set by the QoS
+    # front door, restored by journal replay) so engine-finished and
+    # fleet-finished lines carry it without forking the callers
+    tenant = getattr(req, "tenant", None)
+    if tenant is not None and "tenant" not in entry:
+        entry["tenant"] = tenant
     entry.update(tl.snapshot(n_out))
     try:
         from ..observability import flight

@@ -1364,6 +1364,7 @@ class Engine:
                     _flight.record(
                         "serving", "shed", engine=self.engine_id,
                         request_id=req.request_id, kv_utilization=util,
+                        tenant=getattr(req, "tenant", None),
                     )
                 raise EngineOverloadedError(
                     f"KV pool at {util:.0%} utilization (threshold "

@@ -436,6 +436,10 @@ class Fleet:
             sup.spawn()
             self.replicas.append(sup)
         self._pending: collections.deque = collections.deque()
+        # optional multi-tenant QoS (serving/qos.py): when attached,
+        # the dispatch sweep replaces FIFO with weighted fair-share
+        # selection and completions feed per-tenant accounting
+        self.qos = None
         self._routes: dict = {}     # engine request id -> _Dispatch
         self._ready: list = []      # finished client outputs, buffered
         self._req_counter = itertools.count()
@@ -654,7 +658,7 @@ class Fleet:
 
     # -- client API ----------------------------------------------------------
     def add_request(self, prompt_token_ids, sampling_params=None,
-                    request_id=None):
+                    request_id=None, tenant=None):
         if not self._live():
             raise NoReplicaError(
                 f"fleet {self.fleet_id}: all replicas permanently failed"
@@ -672,8 +676,10 @@ class Fleet:
             self.metrics.requests_shed += 1
             _flight.record(
                 "fleet", "shed", fleet=self.fleet_id,
-                pending=len(self._pending),
+                pending=len(self._pending), tenant=tenant,
             )
+            if self.qos is not None:
+                self.qos.count_queue_shed(tenant)
             raise EngineOverloadedError(
                 f"fleet {self.fleet_id} pending queue full "
                 f"({cfg_f.max_pending} parked); request shed"
@@ -681,6 +687,9 @@ class Fleet:
         if request_id is None:
             request_id = f"fleet{self.fleet_id}-{next(self._req_counter)}"
         freq = FleetRequest(prompt_token_ids, sampling_params, request_id)
+        # tenant set BEFORE the journal ADMIT below so the "tn" field
+        # rides the WAL and replay restores the QoS accounting
+        freq.request.tenant = tenant
         # surface the engine's admission error NOW, not on a later
         # dispatch attempt deep inside step(). Falls back to the fleet's
         # engine config while every replica is quarantined (engine is
@@ -698,6 +707,10 @@ class Fleet:
             )
         self.metrics.requests_received += 1
         self._pending.append(freq)
+        if self.qos is not None:
+            # admission-time accounting stamps the fair-queuing
+            # virtual tags; parked requests age against later arrivals
+            self.qos.on_admit(freq.request)
         if self.journal is not None:
             # WAL the admission before dispatch: once flushed, a crash
             # replays this request instead of losing it
@@ -781,6 +794,8 @@ class Fleet:
         freq.done = True
         freq.output = RequestOutput(req)
         self.metrics.requests_finished += 1
+        if self.qos is not None:
+            self.qos.on_finish(req)
         if self.journal is not None:
             self.journal.finish(req, reason)
             self.journal.flush()
@@ -1033,6 +1048,7 @@ class Fleet:
             _flight.record(
                 "fleet", "timeout", fleet=self.fleet_id,
                 request_id=freq.request_id, where="pending",
+                tenant=getattr(freq.request, "tenant", None),
             )
             self._finish_local(freq, "timeout")
 
@@ -1069,16 +1085,29 @@ class Fleet:
         # pending request
         digests = {}
         while self._pending:
-            freq = self._pending[0]
+            # FIFO without QoS; with QoS attached the sweep dispatches
+            # the weighted-fair-share pick (strict priority class,
+            # then lowest virtual finish tag) instead of the head
+            freq = (
+                self._pending[0] if self.qos is None
+                else self.qos.select(self._pending)
+            )
+            if freq is None:
+                return
             if freq.done:
                 # completed while parked (its hedge won after the
                 # primary's replica died): already delivered, must
                 # not be dispatched — and decoded — a second time
-                self._pending.popleft()
+                self._pending.remove(freq)
                 continue
             if not self._dispatch_one(freq, loads, digests):
                 return
-            self._pending.popleft()
+            self._pending.remove(freq)
+            if self.qos is not None and not freq.done:
+                # done here means _dispatch_one finished it locally
+                # (unplaceable error) — that is not a dispatch, so the
+                # global virtual clock must not advance for it
+                self.qos.on_dispatch(freq.request)
 
     def _dispatch_one(self, freq, loads, digests=None):
         """Place one pending request; False leaves it queued (no
@@ -1266,6 +1295,8 @@ class Fleet:
         # see their own id regardless of which dispatch won
         out.request_id = freq.request_id
         freq.output = out
+        if self.qos is not None:
+            self.qos.on_finish(freq.request)
         if self.journal is not None:
             # the journal is keyed by the PRIMARY rid; a hedge winner
             # closes it with the winning reason (the primary's partial

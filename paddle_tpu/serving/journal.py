@@ -172,14 +172,15 @@ def _fsync_dir(path):
 class ReplayEntry:
     """One unfinished request recovered from the journal."""
 
-    __slots__ = ("rid", "prompt", "params", "out", "ts")
+    __slots__ = ("rid", "prompt", "params", "out", "ts", "tenant")
 
-    def __init__(self, rid, prompt, params, out, ts):
+    def __init__(self, rid, prompt, params, out, ts, tenant=None):
         self.rid = rid          # request id (int or str, as journaled)
         self.prompt = prompt    # prompt token ids
         self.params = params    # SamplingParams dict (to_dict form)
         self.out = out          # tokens already emitted (the cursor)
         self.ts = ts            # wall-clock admission time (time.time)
+        self.tenant = tenant    # QoS tenant id (None pre-QoS journals)
 
     def __repr__(self):
         return (
@@ -226,6 +227,8 @@ def restore_entries(journal, entries, build):
             obj = build(e, params)
             req = getattr(obj, "request", obj)
             req.output_token_ids = list(e.out)
+            if getattr(e, "tenant", None) is not None:
+                req.tenant = e.tenant
             if e.ts is not None:
                 # timeline coherence: anchor arrival at the journaled
                 # wall-clock admission (the same field the TTL math
@@ -342,11 +345,17 @@ class Journal:
         already produced — so replay never double-counts them."""
         rid = req.request_id
         out = list(req.output_token_ids)
-        self._buffer.append({
+        rec = {
             "t": "A", "rid": rid, "p": list(req.prompt_token_ids),
             "sp": req.sampling_params.to_dict(), "out": out,
             "ts": time.time(),
-        })
+        }
+        # tenant attribution rides the ADMIT so a replay restores the
+        # QoS accounting (quota/fair-share charges the right tenant)
+        tenant = getattr(req, "tenant", None)
+        if tenant is not None:
+            rec["tn"] = tenant
+        self._buffer.append(rec)
         self._urgent = True   # admissions are durable before dispatch
         self._open.add(_key(rid))
         req.journal_cursor = len(out)
@@ -652,7 +661,8 @@ class Journal:
                         "rid": rec["rid"], "p": rec.get("p", []),
                         "sp": rec.get("sp", {}),
                         "out": list(rec.get("out", [])),
-                        "ts": rec.get("ts"), "fin": False,
+                        "ts": rec.get("ts"), "tn": rec.get("tn"),
+                        "fin": False,
                     }
                     order.setdefault(k, seq)
                     seq += 1
@@ -685,7 +695,7 @@ class Journal:
         result = [
             ReplayEntry(
                 entries[k]["rid"], entries[k]["p"], entries[k]["sp"],
-                entries[k]["out"], entries[k]["ts"],
+                entries[k]["out"], entries[k]["ts"], entries[k]["tn"],
             )
             for k in unfinished
         ]

@@ -41,6 +41,31 @@ class RequestState(enum.Enum):
     PREFILLING = 3
 
 
+def _check_int(field, value, allow_none=False):
+    """Coerce a user-supplied field to int, or raise a ValueError that
+    names the field (a bad wire payload must surface as a structured
+    4xx, not a deep TypeError from a comparison)."""
+    if value is None and allow_none:
+        return None
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        raise ValueError(
+            f"{field} must be an integer, got "
+            f"{type(value).__name__}: {value!r}"
+        )
+    return int(value)
+
+
+def _check_float(field, value, allow_none=False):
+    if value is None and allow_none:
+        return None
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        raise ValueError(
+            f"{field} must be a number, got "
+            f"{type(value).__name__}: {value!r}"
+        )
+    return float(value)
+
+
 class SamplingParams:
     """Per-request sampling knobs, the serving-side analogue of
     ``generation.GenerationConfig`` (same field semantics — greedy unless
@@ -49,6 +74,14 @@ class SamplingParams:
     def __init__(self, max_new_tokens=16, do_sample=False, temperature=1.0,
                  top_k=0, top_p=1.0, eos_token_id=None, stop_token_ids=(),
                  ttl_s=None, seed=None):
+        max_new_tokens = _check_int("max_new_tokens", max_new_tokens)
+        temperature = _check_float("temperature", temperature)
+        top_k = _check_int("top_k", top_k)
+        top_p = _check_float("top_p", top_p)
+        eos_token_id = _check_int("eos_token_id", eos_token_id,
+                                  allow_none=True)
+        ttl_s = _check_float("ttl_s", ttl_s, allow_none=True)
+        seed = _check_int("seed", seed, allow_none=True)
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}"
@@ -68,7 +101,15 @@ class SamplingParams:
         self.top_k = int(top_k)
         self.top_p = float(top_p)
         self.eos_token_id = eos_token_id
-        self.stop_token_ids = tuple(int(t) for t in stop_token_ids)
+        if isinstance(stop_token_ids, (str, bytes)) or not hasattr(
+                stop_token_ids, "__iter__"):
+            raise ValueError(
+                "stop_token_ids must be a sequence of integers, got "
+                f"{type(stop_token_ids).__name__}: {stop_token_ids!r}"
+            )
+        self.stop_token_ids = tuple(
+            _check_int("stop_token_ids", t) for t in stop_token_ids
+        )
         if ttl_s is not None and ttl_s < 0:
             raise ValueError(f"ttl_s must be >= 0 or None, got {ttl_s}")
         # wall-clock budget from arrival; the engine finishes the request
@@ -265,6 +306,10 @@ class Request:
         # durability: output tokens already written to the request
         # journal (the emit cursor; journal.admit/emit own it)
         self.journal_cursor = 0
+        # multi-tenant QoS attribution (serving/qos.py); None for
+        # in-process callers. Journaled in ADMIT ("tn") so replay
+        # restores per-tenant accounting.
+        self.tenant = None
         # metrics
         self.arrival_time = time.perf_counter()
         self.first_token_time = None

@@ -1,0 +1,527 @@
+"""paddle_tpu.serving.server + qos: the streaming HTTP front door.
+
+Wire-level invariants (tiny shared Llama, compile-lean: single prefill
+bucket, module-scope model; two fleets total — one relaxed for parity,
+one tight for saturation):
+  * greedy SSE streams reassemble BYTE-IDENTICAL to in-process
+    ``Engine.generate()`` output, and a warm server answers with ZERO
+    fresh traces;
+  * malformed requests answer a structured 4xx table naming the
+    offending field — never a stack trace, never a 5xx;
+  * two-tenant weighted fair share (3:1) interleaves dispatch under a
+    saturated queue, quota breaches shed 429 + ``Retry-After`` for the
+    offending tenant ONLY, and per-tenant ``paddle_tpu_serving_*``
+    series answer on the co-hosted ``/metrics``;
+  * a mid-stream client disconnect aborts that request — no slot
+    leak, nothing else disturbed;
+  * drain (the SIGTERM path) finishes in-flight streams while new
+    admissions answer 503 ``server_draining``.
+
+The CLI exits non-zero with a NAMED config error (``ConfigError``)
+for bad flags, checked in-process. The real-SIGTERM variant (a
+``python -m paddle_tpu.serving`` child process drained mid-stream) is
+marked ``slow``.
+"""
+import http.client
+import json
+import os
+import re
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability.latency import SLOConfig
+from paddle_tpu.serving import (
+    Engine,
+    EngineConfig,
+    Fleet,
+    FleetConfig,
+    QoSConfig,
+    SamplingParams,
+    Server,
+    TenantPolicy,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROMPT = [1, 2, 3]
+N_NEW = 8
+
+_COMPILE_COUNTERS = (
+    "prefill_compiles", "prefill_ext_compiles", "decode_compiles",
+    "cow_compiles", "verify_compiles",
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+def _engine_config(**kw):
+    base = dict(
+        max_batch_slots=4, max_model_len=32, page_size=4,
+        prefill_buckets=[32],
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def oracle(model):
+    """In-process reference — the byte-parity baseline."""
+    return Engine(model, _engine_config())
+
+
+@pytest.fixture(scope="module")
+def fleet(model):
+    return Fleet(
+        model, _engine_config(),
+        FleetConfig(num_replicas=1, max_pending=64),
+    )
+
+
+@pytest.fixture(scope="module")
+def server(fleet):
+    srv = Server(fleet, port=0)
+    yield srv
+    srv.close()
+
+
+@pytest.fixture(scope="module")
+def tight_fleet(model):
+    """One slot, one waiting: everything else parks in the fleet
+    pending queue, where fair share decides the dispatch order."""
+    return Fleet(
+        model, _engine_config(max_batch_slots=1, max_waiting=1),
+        FleetConfig(num_replicas=1, max_pending=64),
+    )
+
+
+@pytest.fixture(scope="module")
+def qos_server(tight_fleet):
+    srv = Server(tight_fleet, port=0, qos=QoSConfig(
+        tenants={
+            "alpha": TenantPolicy(weight=3.0),
+            "beta": TenantPolicy(weight=1.0),
+            "gamma": TenantPolicy(max_inflight=2),
+        },
+        api_keys={"sk-alpha": "alpha"},
+        slo=SLOConfig(ttft_p99_ms=10_000.0, tpot_p99_ms=10_000.0),
+    ))
+    yield srv
+    srv.close()
+
+
+# -- tiny HTTP client helpers -------------------------------------------------
+def _post(port, body, headers=None, path="/v1/completions", timeout=120):
+    payload = body if isinstance(body, bytes) else json.dumps(body).encode()
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, body=payload, headers={
+            "Content-Type": "application/json", **(headers or {}),
+        })
+        resp = conn.getresponse()
+        raw = resp.read()
+        return resp.status, dict(resp.getheaders()), (
+            json.loads(raw) if raw else None
+        )
+    finally:
+        conn.close()
+
+
+def _post_stream(port, body, headers=None, timeout=120):
+    """POST with ``stream: true``; returns the decoded SSE events
+    (the final one carries finish_reason + usage)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(
+            "POST", "/v1/completions", body=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     **(headers or {})},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        assert resp.getheader("Content-Type", "").startswith(
+            "text/event-stream"
+        )
+        events = []
+        while True:
+            line = resp.fp.readline()
+            assert line, "stream ended before [DONE]"
+            line = line.strip()
+            if not line:
+                continue
+            assert line.startswith(b"data: "), line
+            payload = line[len(b"data: "):]
+            if payload == b"[DONE]":
+                return events
+            events.append(json.loads(payload))
+    finally:
+        conn.close()
+
+
+def _fleet_compiles(f):
+    total = 0
+    for sup in f.replicas:
+        if sup.engine is not None:
+            m = sup.engine.metrics
+            total += sum(getattr(m, k) for k in _COMPILE_COUNTERS)
+    return total
+
+
+# -- byte parity + compile hygiene -------------------------------------------
+def test_blocking_response_matches_in_process(server, oracle):
+    ref = oracle.generate([PROMPT], SamplingParams(max_new_tokens=N_NEW))[0]
+    status, _, body = _post(
+        server.port, {"prompt": PROMPT, "max_tokens": N_NEW}
+    )
+    assert status == 200
+    assert body["object"] == "text_completion"
+    choice = body["choices"][0]
+    assert choice["token_ids"] == list(ref.token_ids)
+    assert choice["finish_reason"] == ref.finish_reason
+    assert body["usage"] == {
+        "prompt_tokens": len(PROMPT),
+        "completion_tokens": len(ref.token_ids),
+        "total_tokens": len(PROMPT) + len(ref.token_ids),
+    }
+
+
+def test_stream_byte_parity_zero_compiles_warm(server, fleet, oracle):
+    ref = oracle.generate([PROMPT], SamplingParams(max_new_tokens=N_NEW))[0]
+    # first pass warms every trace the server path needs...
+    _post_stream(server.port,
+                 {"prompt": PROMPT, "max_tokens": N_NEW, "stream": True})
+    before = _fleet_compiles(fleet)
+    events = _post_stream(
+        server.port,
+        {"prompt": PROMPT, "max_tokens": N_NEW, "stream": True},
+    )
+    # ...so the second is compile-free end to end
+    assert _fleet_compiles(fleet) == before
+    streamed = [
+        t for ev in events[:-1] for t in ev["choices"][0]["token_ids"]
+    ]
+    final = events[-1]
+    assert final["object"] == "text_completion.chunk"
+    assert streamed == list(ref.token_ids)
+    assert final["choices"][0]["token_ids"] == list(ref.token_ids)
+    assert final["choices"][0]["finish_reason"] == ref.finish_reason
+    assert final["usage"]["completion_tokens"] == len(ref.token_ids)
+
+
+def test_metrics_and_healthz_cohosted(server):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+    try:
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        text = resp.read().decode()
+        assert resp.status == 200
+        assert "paddle_tpu_serving_http_requests_total" in text
+        conn.request("GET", "/healthz")
+        hz = conn.getresponse()
+        body = json.loads(hz.read())
+        assert hz.status == 200
+        assert body["status"] == "ok"
+    finally:
+        conn.close()
+
+
+# -- structured validation ----------------------------------------------------
+@pytest.mark.parametrize("body,param,needle", [
+    (b"{not json", None, "not valid JSON"),
+    ([1, 2, 3], None, "JSON object"),
+    ({}, "prompt", "non-empty list"),
+    ({"prompt": []}, "prompt", "non-empty list"),
+    ({"prompt": "hello"}, "prompt", "token ids"),
+    ({"prompt": [1, True, 3]}, "prompt", "token ids"),
+    ({"prompt": PROMPT, "max_tokens": "lots"}, "max_new_tokens",
+     "must be an integer"),
+    ({"prompt": PROMPT, "temperature": 0}, "temperature", "temperature"),
+    ({"prompt": PROMPT, "top_p": 2.0}, "top_p", "top_p"),
+    ({"prompt": PROMPT, "stream": "yes"}, "stream", "boolean"),
+])
+def test_malformed_request_4xx_table(server, body, param, needle):
+    status, _, resp = _post(server.port, body)
+    assert status == 400
+    err = resp["error"]
+    assert err["type"] == "invalid_request_error"
+    assert needle in err["message"]
+    assert err.get("param") == param
+
+
+def test_unknown_endpoint_404(server):
+    status, _, resp = _post(server.port, {"prompt": PROMPT},
+                            path="/v1/chat/completions")
+    assert status == 404
+    assert resp["error"]["type"] == "invalid_request_error"
+
+
+def test_unknown_api_key_401(qos_server):
+    status, _, resp = _post(
+        qos_server.port, {"prompt": PROMPT, "max_tokens": 2},
+        headers={"Authorization": "Bearer sk-wrong"},
+    )
+    assert status == 401
+    assert resp["error"]["type"] == "authentication_error"
+
+
+# -- multi-tenant QoS ---------------------------------------------------------
+def test_two_tenant_fair_share_interleaves(qos_server, tight_fleet):
+    """Equal 12-deep backlogs at weights 3:1 dispatch interleaved
+    roughly alpha,alpha,alpha,beta — NOT alpha-until-exhausted. The
+    admission-stamped virtual tags are what let parked beta requests
+    age; driven in-process (the HTTP driver only steps while HTTP
+    requests are in flight) for a deterministic dispatch order."""
+    qos = qos_server.qos
+    order = []
+    orig = tight_fleet._dispatch_one
+
+    def spy(freq, loads, digests=None):
+        ok = orig(freq, loads, digests)
+        if ok and not freq.done:
+            order.append(freq.request.tenant)
+        return ok
+
+    tight_fleet._dispatch_one = spy
+    try:
+        params = SamplingParams(max_new_tokens=4)
+        for _ in range(12):
+            tight_fleet.add_request(list(PROMPT), params, tenant="alpha")
+        for _ in range(12):
+            tight_fleet.add_request(list(PROMPT), params, tenant="beta")
+        deadline = time.monotonic() + 300
+        while tight_fleet.has_unfinished():
+            tight_fleet.step()
+            assert time.monotonic() < deadline
+    finally:
+        tight_fleet._dispatch_one = orig
+    assert len(order) == 24
+    first16 = order[:16]
+    assert first16.count("alpha") == 12
+    assert first16.count("beta") == 4
+    # beta interleaves long before alpha's backlog is exhausted
+    assert "beta" in order[:6]
+    snap = qos.snapshot()
+    assert snap["alpha"]["finished"] >= 12
+    assert snap["beta"]["finished"] >= 12
+
+
+def test_quota_429_isolation_and_tenant_metrics(qos_server):
+    """Saturate with alpha; gamma (max_inflight=2) sheds its third
+    concurrent request with 429 + Retry-After while every alpha and
+    the two admitted gammas still answer 200."""
+    results = {"alpha": [], "gamma": []}
+    lock = threading.Lock()
+
+    def worker(tenant, barrier=None):
+        if barrier is not None:
+            barrier.wait()
+        status, headers, body = _post(
+            qos_server.port,
+            {"prompt": list(PROMPT), "max_tokens": 4},
+            headers={"X-Tenant": tenant},
+        )
+        with lock:
+            results[tenant].append((status, headers, body))
+
+    def _received(tenant):
+        return qos_server.qos.snapshot().get(tenant, {}).get("received", 0)
+
+    base = _received("alpha")  # earlier tests share this QoS
+    alphas = [threading.Thread(target=worker, args=("alpha",))
+              for _ in range(6)]
+    for t in alphas:
+        t.start()
+    # wait until every alpha is admitted before gamma piles on
+    deadline = time.monotonic() + 60
+    while _received("alpha") < base + 6:
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+    barrier = threading.Barrier(3)
+    gammas = [threading.Thread(target=worker, args=("gamma", barrier))
+              for _ in range(3)]
+    for t in gammas:
+        t.start()
+    for t in alphas + gammas:
+        t.join(timeout=300)
+        assert not t.is_alive()
+
+    assert [s for s, _, _ in results["alpha"]] == [200] * 6
+    gamma_codes = sorted(s for s, _, _ in results["gamma"])
+    assert gamma_codes == [200, 200, 429]
+    shed = next(r for r in results["gamma"] if r[0] == 429)
+    assert shed[2]["error"]["type"] == "rate_limit_error"
+    assert int(shed[1]["Retry-After"]) >= 1
+    snap = qos_server.qos.snapshot()
+    assert snap["gamma"]["shed_quota"] == 1
+    assert snap["gamma"]["finished"] == 2
+
+    conn = http.client.HTTPConnection(
+        "127.0.0.1", qos_server.port, timeout=30
+    )
+    try:
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+    finally:
+        conn.close()
+    # per-tenant counter/latency/SLO series on the co-hosted endpoint
+    assert re.search(
+        r'paddle_tpu_serving_tenant_requests_total\{[^}]*tenant="gamma"',
+        text)
+    assert re.search(
+        r'paddle_tpu_serving_tenant_shed_quota_total\{[^}]*tenant="gamma"',
+        text)
+    assert re.search(
+        r'paddle_tpu_serving_latency\w*\{[^}]*tenant="alpha"', text)
+    assert re.search(
+        r'paddle_tpu_serving_slo_burn_rate\{[^}]*tenant="alpha"', text)
+
+
+# -- failure paths ------------------------------------------------------------
+def test_mid_stream_disconnect_aborts_no_slot_leak(server, fleet):
+    payload = json.dumps({
+        "prompt": [5, 6, 7], "max_tokens": 24, "stream": True,
+    }).encode()
+    sock = socket.create_connection(("127.0.0.1", server.port),
+                                    timeout=60)
+    try:
+        sock.sendall(
+            b"POST /v1/completions HTTP/1.1\r\n"
+            b"Host: localhost\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(payload)).encode() +
+            b"\r\n\r\n" + payload
+        )
+        buf = b""
+        while b"\ndata: " not in buf:
+            chunk = sock.recv(4096)
+            assert chunk, "connection closed before first SSE chunk"
+            buf += chunk
+    finally:
+        # RST on close: the server's next chunk write fails mid-stream
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+        sock.close()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if not server._streams and not fleet.has_unfinished():
+            break
+        time.sleep(0.02)
+    assert not server._streams           # handler released the stream
+    assert not fleet.has_unfinished()    # slot freed: no leak
+    assert server.metrics.disconnects >= 1
+
+
+def test_drain_finishes_inflight_then_503(fleet):
+    srv = Server(fleet, port=0)
+    try:
+        done = {}
+
+        def go():
+            done["events"] = _post_stream(srv.port, {
+                "prompt": [2, 4, 6], "max_tokens": N_NEW, "stream": True,
+            })
+
+        t = threading.Thread(target=go)
+        t.start()
+        deadline = time.monotonic() + 60
+        while not srv._streams and t.is_alive():
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        assert srv.drain(timeout=120)    # in-flight stream completed
+        status, _, body = _post(srv.port, {"prompt": PROMPT,
+                                           "max_tokens": 2})
+        assert status == 503
+        assert body["error"]["type"] == "server_draining"
+        t.join(timeout=60)
+        assert not t.is_alive()
+        final = done["events"][-1]
+        assert len(final["choices"][0]["token_ids"]) == N_NEW
+        assert final["choices"][0]["finish_reason"] == "length"
+    finally:
+        srv.close()
+
+
+# -- CLI ----------------------------------------------------------------------
+def test_cli_named_config_errors(capsys):
+    from paddle_tpu.serving.__main__ import main
+
+    assert main(["serve", "--model", "nope"]) == 2
+    err = capsys.readouterr().err
+    assert "error: ConfigError" in err and "unknown model" in err
+
+    assert main(["serve", "--model", "tiny", "--port", "99999"]) == 2
+    err = capsys.readouterr().err
+    assert "error: ConfigError" in err and "--port" in err
+
+    assert main(["serve", "--model", "tiny",
+                 "--api-key", "broken"]) == 2
+    err = capsys.readouterr().err
+    assert "error: ConfigError" in err and "--api-key" in err
+
+    assert main(["serve", "--model", "tiny",
+                 "--tp-degree", "0"]) == 2
+    err = capsys.readouterr().err
+    assert "error: ConfigError" in err and "--tp-degree" in err
+
+    assert main([]) == 2  # no subcommand: usage, not a stack trace
+
+
+@pytest.mark.slow
+def test_sigterm_drains_inflight_stream():
+    """A real ``python -m paddle_tpu.serving`` child: SIGTERM mid-
+    stream lets the stream finish ([DONE] observed) and exits 0."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.serving", "serve",
+         "--model", "tiny", "--host", "127.0.0.1", "--port", "0",
+         "--max-batch-slots", "2", "--max-model-len", "32"],
+        cwd=REPO_ROOT, env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        line = proc.stdout.readline()
+        m = re.search(r"http://127\.0\.0\.1:(\d+)", line)
+        assert m, f"no listening line: {line!r}"
+        port = int(m.group(1))
+
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=300)
+        conn.request(
+            "POST", "/v1/completions",
+            body=json.dumps({"prompt": [1, 2, 3], "max_tokens": 16,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        # first chunk proves the stream is live, then SIGTERM
+        first = resp.fp.readline()
+        assert first.startswith(b"data: ")
+        proc.send_signal(signal.SIGTERM)
+        saw_done = False
+        while True:
+            ln = resp.fp.readline()
+            if not ln:
+                break
+            if ln.strip() == b"data: [DONE]":
+                saw_done = True
+        conn.close()
+        assert saw_done, "drain cut the in-flight stream short"
+        assert proc.wait(timeout=120) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
