@@ -951,7 +951,11 @@ def bench_fleet(paddle, on_tpu):
     in-flight requests are re-enqueued on the survivor (deterministic
     re-prefill), and the clock stops when the first failed-over request
     produces its next token. This is the serving-side RTO term next to
-    the checkpoint-restore one measured by the [resilience] row."""
+    the checkpoint-restore one measured by the [resilience] row.
+    ``fleet_scale_up_ms`` / ``fleet_shrink_migration_ms`` time the
+    elastic path: autoscaler burn-signal-to-first-token on a freshly
+    placed replica (warm cache) and scale_down drain-to-last-migrated-
+    token (journal-backed migration + re-prefill on a survivor)."""
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.resilience import FaultSpec, faults
     from paddle_tpu.serving import (
@@ -1092,6 +1096,156 @@ def bench_fleet(paddle, on_tpu):
             "value": round(recovered_ms, 1),
             "unit": "ms",
         }))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    # ---- elastic scaling (placement plans): ``fleet_scale_up_ms`` is
+    # burn-signal-to-first-token — the wall clock from the sustained
+    # SLO burn flipping to the first token the autoscaler-spawned
+    # replica serves through the warm compile cache (its slice's
+    # programs pre-serialized, zero fresh traces).
+    # ``fleet_shrink_migration_ms`` is drain-to-last-migrated-token —
+    # scale_down() journaling + re-admitting the victim's in-flight
+    # requests, until every one of them has produced its next token on
+    # a surviving replica (re-prefill included). Needs 3 tp=2 slices;
+    # skips below 6 visible devices.
+    import jax as _jax
+
+    if len(_jax.devices()) < 6:
+        log("[fleet] elastic row skipped: needs >= 6 devices "
+            "(3 tp=2 slices; force with "
+            "--xla_force_host_platform_device_count)")
+        for metric in ("fleet_scale_up_ms", "fleet_shrink_migration_ms"):
+            print(json.dumps({"metric": metric, "skipped": True}))
+        return failover_ms
+    from paddle_tpu.observability.latency import SLOConfig
+    from paddle_tpu.serving import PlacementPlan, ScalingPolicy
+
+    root = tempfile.mkdtemp(prefix="paddle_tpu_elastic_bench_")
+    try:
+        ecfg_e = EngineConfig(
+            max_batch_slots=slots, max_model_len=mml,
+            page_size=16 if on_tpu else 8, tp_degree=2,
+            compile_cache=os.path.join(root, "cc"),
+            slo=SLOConfig(ttft_p99_ms=1.0, tpot_p99_ms=1.0,
+                          window_s=60.0, min_samples=4),
+        )
+        # pre-warm the expansion slice's programs: the scale-up figure
+        # measures the warm path (the cold path is the [compilecache]
+        # row's cold build)
+        from paddle_tpu.serving import Engine as _Engine
+
+        ecfg_w = EngineConfig(
+            max_batch_slots=slots, max_model_len=mml,
+            page_size=16 if on_tpu else 8, tp_degree=2,
+            devices=[4, 5], compile_cache=os.path.join(root, "cc"),
+        )
+        t0 = time.perf_counter()
+        warm_eng = _Engine(model, ecfg_w)
+        warm_eng.generate(prompts[:2], params)
+        del warm_eng
+        log(f"[fleet] expansion slice pre-warm: "
+            f"{time.perf_counter()-t0:.1f}s")
+        f3 = Fleet(model, ecfg_e, FleetConfig(
+            num_replicas=2,
+            placement=PlacementPlan(tp_degree=2),
+            scaling=ScalingPolicy(
+                min_replicas=2, max_replicas=3, up_hold_s=0.0,
+                down_hold_s=1e9, cooldown_s=1e9,
+            ),
+            analysis_check=None,
+        ))
+        f3.generate(prompts, params)   # warm r0/r1, steady state
+        reqs = [f3.add_request(p, params) for p in prompts]
+        # the burn signal flips now; the next step's autoscaler tick
+        # spawns r2 and the open-loop arrival stream below routes onto
+        # it (least-loaded) the moment it joins
+        t0 = time.perf_counter()
+        for s in f3.replicas:
+            for _ in range(6):
+                s.engine.slo.record(ttft_s=1.0)
+        scale_up_ms = None
+        for i in range(10000):
+            f3.step()
+            reqs.append(
+                f3.add_request(prompts[i % len(prompts)], params)
+            )
+            if any(
+                d.replica == "r2" and d.request.output_token_ids
+                for d in f3._routes.values()
+            ):
+                scale_up_ms = (time.perf_counter() - t0) * 1e3
+                break
+        if scale_up_ms is None or f3.metrics.scale_ups != 1:
+            raise RuntimeError(
+                f"elastic bench did not scale up (scale_ups="
+                f"{f3.metrics.scale_ups})"
+            )
+        new_eng = f3.replica("r2").engine
+        fresh = (new_eng.metrics.prefill_compiles
+                 + new_eng.metrics.decode_compiles)
+        log(f"[fleet] scale-up burn-signal-to-first-token: "
+            f"{scale_up_ms:.1f}ms (replica r2 on devices "
+            f"{new_eng.tp.device_ids}, fresh traces={fresh})")
+        print(json.dumps({
+            "metric": "fleet_scale_up_ms",
+            "value": round(scale_up_ms, 1),
+            "unit": "ms",
+        }))
+        while f3.has_unfinished():
+            f3.step()
+
+        # forced shrink: migrate the most-loaded replica's in-flight
+        # requests and clock until the last migrated request produces
+        # its next token on a survivor
+        reqs = [f3.add_request(p, params) for p in prompts]
+        for _ in range(4):
+            f3.step()
+        victim = max(
+            (s for s in f3.replicas if s.engine is not None),
+            key=lambda s: s.load(),
+        )
+        moving = {
+            d.fleet_req.request_id: len(d.request.output_token_ids)
+            for d in f3._routes.values()
+            if d.replica == victim.name and not d.cancelled
+            and not d.finished
+        }
+        t0 = time.perf_counter()
+        released = f3.scale_down(replica=victim.name)
+        if released is None or not moving:
+            raise RuntimeError(
+                f"elastic bench shrink moved nothing "
+                f"(migrated={f3.metrics.requests_migrated})"
+            )
+        shrink_ms = None
+        done_rids = set()
+        for _ in range(10000):
+            for out in f3.step():
+                done_rids.add(out.request_id)
+            if all(
+                rid in done_rids or any(
+                    d.fleet_req.request_id == rid
+                    and len(d.request.output_token_ids) > cur
+                    for d in f3._routes.values()
+                )
+                for rid, cur in moving.items()
+            ):
+                shrink_ms = (time.perf_counter() - t0) * 1e3
+                break
+        if shrink_ms is None:
+            raise RuntimeError("elastic bench shrink never drained")
+        log(f"[fleet] shrink drain-to-last-migrated-token: "
+            f"{shrink_ms:.1f}ms ({len(moving)} in-flight requests "
+            f"migrated off {victim.name}, "
+            f"{f3.metrics.requests_migrated} total)")
+        print(json.dumps({
+            "metric": "fleet_shrink_migration_ms",
+            "value": round(shrink_ms, 1),
+            "unit": "ms",
+        }))
+        while f3.has_unfinished():
+            f3.step()
     finally:
         shutil.rmtree(root, ignore_errors=True)
     return failover_ms

@@ -29,6 +29,12 @@ from .fleet import (
 from .journal import Journal, ReplayEntry
 from .kv_cache import BlockManager, KVPool
 from .metrics import EngineMetrics
+from .placement import (
+    Autoscaler,
+    PlacementError,
+    PlacementPlan,
+    ScalingPolicy,
+)
 from .prefix_cache import PrefixCache, PrefixMatch
 from .qos import (
     QoS,
@@ -56,6 +62,7 @@ __all__ = [
     "PrefixCache", "PrefixMatch", "Journal", "ReplayEntry", "AccessLog",
     "Fleet", "FleetConfig", "FleetMetrics", "FleetRequest",
     "NoReplicaError", "ReplicaSupervisor", "TPSpec", "build_tp_mesh",
+    "PlacementPlan", "PlacementError", "ScalingPolicy", "Autoscaler",
     "Server", "serve", "QoS", "QoSConfig", "QoSRejection",
     "TenantPolicy", "UnknownTenantError",
 ]

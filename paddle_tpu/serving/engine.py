@@ -858,9 +858,16 @@ class Engine:
         # pre-existing single-chip on-disk program valid); dk is the
         # EFFECTIVE kernel (a sharded engine's "pallas" degraded to
         # "xla" must key the program actually built)
+        # device ids join the sharded signature too: deserialized
+        # executables are DEVICE-PINNED (one compiled for a mesh over
+        # [0,1] fails its input-sharding check when launched on [4,5]),
+        # so two fleet replicas on different placement slices must
+        # never alias to one cached program. devices=None resolves to
+        # the first tp ids, so pre-existing sharded caches stay warm.
         tp_sig = (
             f"tp={self.config.tp_degree}:"
             f"tpn={self.config.tp_numerics}:"
+            f"dev={','.join(str(i) for i in self.tp.device_ids)}:"
             if self.tp is not None else ""
         )
         sig = (
@@ -972,8 +979,11 @@ class Engine:
             # tp= keys the service only when sharding is on, so every
             # single-chip manifest written before this existed stays
             # live; a sharded engine warm-restarts from its OWN tp=N
-            # manifest (docs/compilecache.md)
+            # manifest (docs/compilecache.md). dev= pins the manifest
+            # to the placement slice — cached executables are
+            # device-pinned, so each slice warms its own program set
             + (f"|tp={cfg.tp_degree}|tpn={cfg.tp_numerics}"
+               f"|dev={','.join(str(i) for i in self.tp.device_ids)}"
                if self.tp is not None else "")
             + f"|code={self._adapter_code_fp}"
         )
@@ -1463,6 +1473,36 @@ class Engine:
                 self._finish(req, "aborted", self._aborted)
                 return True
         return False
+
+    def release(self, request_id):
+        """Detach an unfinished request from this engine WITHOUT
+        finishing it — the fleet's migration primitive (scale-down,
+        rolling restart). KV blocks and the slot are freed, scheduling
+        state resets to WAITING with ``num_cached=0``, and the Request
+        object — prompt, generated tokens, tenant tag, arrival/deadline
+        clocks — is returned intact for :meth:`resume` on another
+        replica (re-prefill over ``prompt + output[:-1]``; greedy
+        continuation byte-identical). No finish accounting, no
+        RequestOutput: from the caller's point of view the request is
+        still in flight, just homeless. Returns None when the id is not
+        here or already finished."""
+        req = None
+        for r in list(self.waiting):
+            if r.request_id == request_id:
+                self.waiting.remove(r)
+                req = r
+                break
+        if req is None:
+            for r in self.slots:
+                if r is not None and r.request_id == request_id:
+                    req = r
+                    break
+        if req is None or req.state is RequestState.FINISHED:
+            return None
+        self._release(req)
+        req.state = RequestState.WAITING
+        req.num_cached = 0
+        return req
 
     def has_unfinished(self):
         return bool(self._aborted) or bool(self.waiting) or any(

@@ -36,7 +36,26 @@ Scale" over the primitives the previous PRs built:
   * **Rolling drain/restart** — ``drain(replica)`` stops admission and
     steps the fleet until the replica's in-flight work completes;
     ``rolling_restart(min_available=k)`` cycles replicas through
-    drain → rebuild (weight reload) → rejoin without dropping requests.
+    migrate → rebuild (weight reload) → rejoin without dropping
+    requests (in-flight work moves to the other replicas via the
+    journal-backed migration below instead of waiting out the drain).
+  * **Elastic pod-scale placement** — ``FleetConfig(placement=...)``
+    (``serving.placement.PlacementPlan``) carves the visible device
+    set into disjoint per-replica TP slices; spawn, crash-restart and
+    rolling restart all rebuild a replica onto ITS slice through the
+    ``EngineConfig(devices=)`` path. ``FleetConfig(scaling=...)``
+    (``ScalingPolicy``) adds the elasticity loop: sustained pooled SLO
+    burn (or pending depth) with a free slice grows the fleet through
+    the warm compile cache's zero-trace spawn; sustained idle shrinks
+    it — both with hysteresis holds, a min/max envelope, and cooldown.
+    Shrink (and rolling restart) move in-flight requests off the
+    departing replica with ``Engine.release`` → re-ADMIT at the HEAD
+    of the pending queue → ``Engine.resume`` re-prefill: greedy
+    outputs stay byte-identical, and the journal's replica-epoch
+    records make a mid-shrink crash replay exactly-once. Every
+    scaling action is counted, flight-recorded, and degradable behind
+    the ``fleet.scale`` / ``fleet.place`` fault sites — a failed
+    spawn or placement never takes down serving traffic.
 
 Observability is end-to-end: a pull-time collector view exports
 ``paddle_tpu_fleet_*`` series (failovers, hedges won/lost, restarts,
@@ -47,6 +66,7 @@ recorder postmortem before the restart begins.
 from __future__ import annotations
 
 import collections
+import copy
 import itertools
 import threading
 import time
@@ -65,6 +85,7 @@ from ..observability.metrics import register_latency_view
 from ..resilience import faults
 from .access_log import record_finish
 from .engine import Engine, EngineConfig, EngineOverloadedError
+from .placement import Autoscaler, PlacementError, PlacementPlan, ScalingPolicy
 from .prefix_cache import prompt_chain_digests
 from .request import (
     Request,
@@ -90,7 +111,8 @@ _fleet_counter = itertools.count(1)
 class FleetConfig:
     def __init__(self, num_replicas=2, hedge_after_s=None, max_restarts=2,
                  restart_policy=None, analysis_check="error",
-                 max_pending=None, journal_dir=None):
+                 max_pending=None, journal_dir=None, placement=None,
+                 scaling=None):
         if num_replicas < 1:
             raise ValueError(
                 f"num_replicas must be >= 1, got {num_replicas}"
@@ -141,6 +163,36 @@ class FleetConfig:
         # A restarting fleet replays it before traffic; see
         # docs/serving.md "Request durability".
         self.journal_dir = journal_dir
+        # device-placement plan (serving/placement.py): disjoint
+        # per-replica TP slices over the visible device set. Validated
+        # HERE — an overlapping/oversubscribed/indivisible plan raises
+        # PlacementError at config construction, before any engine (or
+        # XLA mesh) exists.
+        if placement is not None:
+            if not isinstance(placement, PlacementPlan):
+                raise PlacementError(
+                    f"FleetConfig(placement=) takes a "
+                    f"serving.PlacementPlan, got "
+                    f"{type(placement).__name__}"
+                )
+            placement.validate(num_replicas)
+        self.placement = placement
+        # elastic scaling policy: needs a placement plan (a scaled-up
+        # replica must have a slice to land on)
+        if scaling is not None:
+            if not isinstance(scaling, ScalingPolicy):
+                raise ValueError(
+                    f"FleetConfig(scaling=) takes a "
+                    f"serving.ScalingPolicy, got "
+                    f"{type(scaling).__name__}"
+                )
+            if placement is None:
+                raise ValueError(
+                    "FleetConfig(scaling=) requires placement=: the "
+                    "autoscaler can only spawn replicas onto placement "
+                    "slices"
+                )
+        self.scaling = scaling
 
 
 class FleetMetrics:
@@ -163,6 +215,10 @@ class FleetMetrics:
         self.replicas_failed = 0      # permanent failures (fleet shrank)
         self.route_errors = 0
         self.route_prefix_hits = 0    # placements won by prefix affinity
+        self.scale_ups = 0            # replicas added (manual+autoscale)
+        self.scale_downs = 0          # replicas released
+        self.scale_errors = 0         # degraded scaling ops (fault/spawn)
+        self.requests_migrated = 0    # in-flight moved off a departing replica
         # failover recovery timing (the bench [fleet] row): stamped at
         # death detection and at the first token a re-enqueued request
         # produces on its new replica
@@ -197,6 +253,20 @@ _FLEET_COUNTERS = {
     "replicas_failed": "paddle_tpu_fleet_replicas_failed_total",
     "route_errors": "paddle_tpu_fleet_route_errors_total",
     "route_prefix_hits": "paddle_tpu_fleet_route_prefix_hits_total",
+    "scale_ups": "paddle_tpu_fleet_scale_ups_total",
+    "scale_downs": "paddle_tpu_fleet_scale_downs_total",
+    "scale_errors": "paddle_tpu_fleet_scale_errors_total",
+    "requests_migrated": "paddle_tpu_fleet_requests_migrated_total",
+}
+
+# supervisor status -> the lifecycle state exported on the
+# paddle_tpu_fleet_replicas{state=} gauge (scale events read as edges:
+# spawning -> live on scale-up, draining -> released on scale-down)
+_REPLICA_STATES = ("spawning", "live", "draining", "released", "failed")
+_STATUS_TO_STATE = {
+    "offline": "spawning", "quarantined": "spawning",
+    "healthy": "live", "draining": "draining",
+    "released": "released", "failed": "failed",
 }
 
 
@@ -280,6 +350,31 @@ def _register_view(fleet):
             up, restarts, pfx_hits, pfx_tokens, pfill, reclaimable,
             tp_deg,
         ]
+        # replica lifecycle states, zero-filled over every state so a
+        # scale event is a visible edge (0->1 spawning, 1->0 live, ...)
+        # even on a fleet that has never scaled; released replicas are
+        # the retired ring (bounded), not fl.replicas
+        states = MetricFamily("paddle_tpu_fleet_replicas", "gauge")
+        counts = dict.fromkeys(_REPLICA_STATES, 0)
+        for sup in fl.replicas:
+            counts[_STATUS_TO_STATE.get(sup.status, "live")] += 1
+        counts["released"] += len(fl._retired)
+        for st in _REPLICA_STATES:
+            states.add(counts[st], {**label, "state": st})
+        fams.append(states)
+        # device placement: one sample per (replica, device id) — the
+        # scrape-side proof that slices are disjoint and scale-ups
+        # landed on unused chips
+        devs = MetricFamily("paddle_tpu_fleet_replica_devices", "gauge")
+        for sup in fl.replicas:
+            if sup.devices:
+                for did in sup.devices:
+                    devs.add(1.0, {
+                        **label, "replica": sup.name,
+                        "device": f"{did}",
+                    })
+        if devs.samples:
+            fams.append(devs)
         cfg, pooled = fl._slo_pool()
         if cfg is not None:
             # fleet-level burn from POOLED windows (the per-replica
@@ -430,11 +525,40 @@ class Fleet:
                 self._access_log = resolve_access_log(
                     engine_config.access_log
                 )
+        plan = self.config.placement
+        if plan is not None and (
+            engine_config is None
+            or engine_config.tp_degree != plan.tp_degree
+        ):
+            raise PlacementError(
+                f"FleetConfig(placement=) carves slices of "
+                f"{plan.tp_degree} device(s) but EngineConfig("
+                f"tp_degree="
+                f"{getattr(engine_config, 'tp_degree', None)}) does "
+                f"not match: the slice width IS the replica's "
+                f"tensor-parallel degree"
+            )
         self.replicas: list = []
         for i in range(self.config.num_replicas):
-            sup = self._make_supervisor(f"r{i}")
+            sup = self._make_supervisor(
+                f"r{i}",
+                devices=plan.slice_ids(i) if plan is not None else None,
+                slice_index=i if plan is not None else None,
+            )
             sup.spawn()
             self.replicas.append(sup)
+        # scale-up names continue past the seed replicas and are never
+        # reused (metric labels / journal epoch records must not alias
+        # a released replica with a later one)
+        self._replica_counter = itertools.count(self.config.num_replicas)
+        # released supervisors (scale-down), kept for the state gauge
+        # and introspection; bounded so a long-lived elastic fleet
+        # cannot grow it without limit
+        self._retired: list = []
+        self._autoscaler = (
+            Autoscaler(self.config.scaling)
+            if self.config.scaling is not None else None
+        )
         self._pending: collections.deque = collections.deque()
         # optional multi-tenant QoS (serving/qos.py): when attached,
         # the dispatch sweep replaces FIFO with weighted fair-share
@@ -469,16 +593,35 @@ class Fleet:
 
         register_health_provider(f"serving.fleet.{self.fleet_id}", _probe)
 
-    def _make_supervisor(self, name):
+    def _make_supervisor(self, name, devices=None, slice_index=None):
         cfg = self.config
         # the factory closes over the fleet (not a model snapshot) so
         # rolling_restart(model=...) reloads weights on rebuild
+        if devices is None:
+            factory = lambda: Engine(self._model, self.engine_config)
+        else:
+            def factory(devices=list(devices)):
+                # the slice is baked into the factory, so EVERY build
+                # of this replica — first spawn, background crash
+                # restart (restart_policy.call(self._build, ...)),
+                # rolling rebuild — lands on ITS devices, never the
+                # fleet-wide shared list. fleet.place is the
+                # deterministic placement-failure injection point.
+                faults.fire(
+                    "fleet.place", fleet=self.fleet_id, replica=name,
+                    devices=devices,
+                )
+                ecfg = copy.copy(self.engine_config)
+                ecfg.devices = devices
+                return Engine(self._model, ecfg)
         return ReplicaSupervisor(
             name,
-            factory=lambda: Engine(self._model, self.engine_config),
+            factory=factory,
             restart_policy=cfg.restart_policy,
             max_restarts=cfg.max_restarts,
             analysis_check=cfg.analysis_check,
+            devices=devices,
+            slice_index=slice_index,
         )
 
     # -- durable request journal ---------------------------------------------
@@ -493,6 +636,17 @@ class Fleet:
         ``max_pending``: bounded admission must never drop requests
         the fleet already accepted."""
         entries = self.journal.replay()
+        report = self.journal.replay_report or {}
+        if report.get("interrupted_ops"):
+            # a scaling op's *-begin with no *-end: the crash landed
+            # mid-shrink/mid-restart. Delivery is still exactly-once
+            # (the migration re-ADMITs won the latest-ADMIT-wins fold
+            # before the epoch bracket closed) — surfaced here so the
+            # postmortem shows WHICH op was cut short
+            _flight.record(
+                "fleet", "scale-interrupted", fleet=self.fleet_id,
+                ops=report["interrupted_ops"],
+            )
         # fleet rids are "fleet<id>-<n>": a fresh process restarts the
         # counter at 0, which would collide new rids with replayed
         # ones — advance past every journaled suffix
@@ -562,7 +716,7 @@ class Fleet:
             status = "ok"
         else:
             status = "degraded"
-        return {
+        out = {
             "status": status,
             "replicas": statuses,
             "routable": routable,
@@ -574,6 +728,11 @@ class Fleet:
                 if cfg is not None else None
             ),
         }
+        if self.config.placement is not None:
+            out["placement"] = {
+                s.name: list(s.devices or []) for s in self.replicas
+            }
+        return out
 
     def _absorb_latency(self, sup):
         """Fold a dying/rebuilding replica's cumulative latency digests
@@ -647,9 +806,12 @@ class Fleet:
         m = self.metrics
         out = {attr: getattr(m, attr) for attr in _FLEET_COUNTERS}
         out["replicas"] = {
-            s.name: {"status": s.status, "restarts": s.restarts}
+            s.name: {"status": s.status, "restarts": s.restarts,
+                     "devices": s.devices}
             for s in self.replicas
         }
+        if self._retired:
+            out["retired"] = [s.name for s in self._retired]
         out["pending"] = len(self._pending)
         return out
 
@@ -928,7 +1090,17 @@ class Fleet:
                     f"{healthy_others} other healthy replica(s), "
                     f"min_available={min_available}"
                 )
-            self.drain(sup)
+            # journal-backed migration instead of stepping out a full
+            # drain: in-flight work moves to the pending-queue HEAD and
+            # re-places through resume() (greedy byte-identical) while
+            # this replica rebuilds — the restart no longer waits for
+            # its longest request
+            if sup.status == "healthy":
+                sup.status = "draining"
+            if self.journal is not None:
+                self.journal.epoch("restart-begin", replica=sup.name)
+                self.journal.flush()
+            self._migrate_inflight(sup)
             with span("fleet.restart", replica=sup.name, rolling=True):
                 self._absorb_latency(sup)  # folds digests, drops engine
                 try:
@@ -944,11 +1116,265 @@ class Fleet:
                     )
                     continue
             self.metrics.restarts += 1
+            if self.journal is not None:
+                self.journal.epoch("restart-end", replica=sup.name)
+                self.journal.flush()
             _flight.record(
                 "fleet", "rolling-restart", fleet=self.fleet_id,
                 replica=sup.name,
             )
+            # migrated work re-places now (possibly straight back onto
+            # the rebuilt replica) instead of waiting for the next step
+            self._dispatch_pending()
         return self
+
+    # -- elastic scaling -----------------------------------------------------
+    def _free_slice_index(self):
+        """Lowest placement slice no non-failed replica holds, or None
+        (quarantined replicas keep their slice — the background
+        restart rebuilds onto it; permanently failed and released
+        replicas give theirs up)."""
+        plan = self.config.placement
+        if plan is None:
+            return None
+        held = {
+            s.slice_index for s in self.replicas
+            if s.slice_index is not None and s.status != "failed"
+        }
+        for i in range(plan.capacity()):
+            if i not in held:
+                return i
+        return None
+
+    def scale_up(self, reason="manual"):
+        """Spawn one replica onto the lowest unused placement slice.
+        Returns the new supervisor, or None when no slice is free or
+        the op degraded (an injected ``fleet.scale``/``fleet.place``
+        fault or a spawn failure is counted and flight-recorded, never
+        raised — a failed scale-up must not take down serving
+        traffic). The spawn is synchronous: on a warm shared compile
+        cache it replays the manifest with zero fresh traces (the
+        ~200ms restart path), so the new replica is routable on the
+        very next dispatch sweep."""
+        plan = self.config.placement
+        if plan is None:
+            raise RuntimeError(
+                f"fleet {self.fleet_id} has no placement plan: "
+                "scale_up needs FleetConfig(placement=) to know which "
+                "devices a new replica may use"
+            )
+        idx = self._free_slice_index()
+        if idx is None:
+            return None
+        name = f"r{next(self._replica_counter)}"
+        devices = plan.slice_ids(idx)
+        try:
+            faults.fire(
+                "fleet.scale", fleet=self.fleet_id, action="up",
+                replica=name, reason=reason,
+            )
+            sup = self._make_supervisor(
+                name, devices=devices, slice_index=idx
+            )
+            with span(
+                "fleet.scale", action="up", replica=name,
+                reason=reason,
+            ):
+                sup.spawn()
+        except Exception as e:
+            # analysis: allow(broad-except) the degradation contract
+            # for scaling ops: a failed spawn (injected fault, OOM,
+            # bad slice) is counted and the fleet keeps serving at its
+            # current size
+            self.metrics.scale_errors += 1
+            _flight.record(
+                "fleet", "scale-error", fleet=self.fleet_id,
+                action="up", replica=name, devices=devices,
+                error=f"{type(e).__name__}: {e}",
+            )
+            return None
+        self.replicas.append(sup)
+        self.metrics.scale_ups += 1
+        if self.journal is not None:
+            # epoch record: replay distinguishes a completed scale-up
+            # from one the crash interrupted (idempotency itself rides
+            # the ADMIT contract, not this marker)
+            self.journal.epoch("scale-up", replica=name)
+            self.journal.flush()
+        _flight.record(
+            "fleet", "scale-up", fleet=self.fleet_id, replica=name,
+            devices=devices, reason=reason,
+        )
+        self._dispatch_pending()
+        return sup
+
+    def scale_down(self, replica=None, reason="manual"):
+        """Release one replica (named, or the least-loaded healthy
+        one): migrate its in-flight work to the pending-queue head,
+        fold its telemetry, drop its engine — the slice is free for a
+        later scale-up. Returns the released supervisor, or None when
+        nothing can shrink (last serving replica, no healthy
+        candidate) or the op degraded behind ``fleet.scale``. The
+        journal brackets the migration in ``shrink-begin``/
+        ``shrink-end`` epoch records, so a replay can report a
+        mid-shrink crash (delivery stays exactly-once through the
+        re-ADMITs' latest-ADMIT-wins keying either way)."""
+        if replica is not None:
+            sup = (
+                self.replica(replica) if isinstance(replica, str)
+                else replica
+            )
+            if sup.status not in ("healthy", "draining"):
+                return None
+        else:
+            cands = [s for s in self.replicas if s.status == "healthy"]
+            if not cands:
+                return None
+            sup = min(cands, key=lambda s: s.load())
+        serving_after = sum(
+            s is not sup and s.status in ("healthy", "draining")
+            for s in self.replicas
+        )
+        if serving_after < 1:
+            return None  # never shrink away the last serving replica
+        try:
+            faults.fire(
+                "fleet.scale", fleet=self.fleet_id, action="down",
+                replica=sup.name, reason=reason,
+            )
+        except Exception as e:
+            # analysis: allow(broad-except) same degradation contract
+            # as scale_up: a faulted shrink leaves the fleet as it was
+            self.metrics.scale_errors += 1
+            _flight.record(
+                "fleet", "scale-error", fleet=self.fleet_id,
+                action="down", replica=sup.name,
+                error=f"{type(e).__name__}: {e}",
+            )
+            return None
+        with span(
+            "fleet.scale", action="down", replica=sup.name,
+            reason=reason,
+        ):
+            sup.status = "draining"
+            if self.journal is not None:
+                self.journal.epoch("shrink-begin", replica=sup.name)
+                self.journal.flush()
+            migrated = self._migrate_inflight(sup)
+            self._absorb_latency(sup)  # folds digests, drops engine
+            sup.status = "released"
+            self.replicas.remove(sup)
+            self._retired.append(sup)
+            del self._retired[:-8]
+            if self.journal is not None:
+                self.journal.epoch("shrink-end", replica=sup.name)
+                self.journal.flush()
+        self.metrics.scale_downs += 1
+        _flight.record(
+            "fleet", "scale-down", fleet=self.fleet_id,
+            replica=sup.name, devices=sup.devices, reason=reason,
+            migrated=migrated,
+        )
+        self._dispatch_pending()
+        return sup
+
+    def _migrate_inflight(self, sup):
+        """Move every in-flight request off ``sup``'s LIVE engine:
+        release (KV freed, no finish accounting), re-ADMIT to the
+        journal with the emit cursor, and re-queue at the HEAD of the
+        pending queue oldest-first — dispatch re-places them through
+        the ``resume()`` re-prefill, so greedy continuations are
+        byte-identical to an uninterrupted run. The migrated Request
+        objects keep their arrival/deadline clocks and QoS fair-queue
+        tags: ``_expire_pending`` sees the journaled arrival (TTL
+        anchored at admission, not migration) and tenants are charged
+        once. The live-engine sibling of ``_on_replica_death``'s
+        route sweep; returns the number migrated."""
+        eng = sup.engine
+        if eng is None:
+            return 0
+        # finished-but-undelivered / cancelled / hedge routes first:
+        # completions are delivered, hedge dispatches are dropped (the
+        # primary keeps running elsewhere; resolution is counted at
+        # its finish), cancelled losers just release their route
+        for d in list(self._routes.values()):
+            if d.replica != sup.name:
+                continue
+            req = d.request
+            if req.state is RequestState.FINISHED:
+                self._collect(RequestOutput(req))
+            elif d.cancelled:
+                self._routes.pop(req.request_id, None)
+            elif d.kind == "hedge":
+                d.finished = True
+                self._routes.pop(req.request_id, None)
+        moved = []
+        slot_reqs = sorted(
+            (r for r in eng.slots if r is not None),
+            key=lambda r: r.admit_seq,
+        )
+        for req in slot_reqs + list(eng.waiting):
+            d = self._routes.get(req.request_id)
+            if (d is None or d.cancelled or d.kind != "primary"
+                    or d.fleet_req.done):
+                continue
+            if eng.release(req.request_id) is None:
+                continue
+            self._routes.pop(req.request_id, None)
+            freq = d.fleet_req
+            freq.dispatches.remove(d)
+            if self.journal is not None:
+                # re-ADMIT with the emit cursor: replay never
+                # re-counts tokens this request already produced, and
+                # latest-ADMIT-wins makes a replayed migration
+                # idempotent
+                self.journal.admit(req)
+            if self.qos is not None:
+                self.qos.on_migrate(req)
+            self.metrics.requests_migrated += 1
+            _flight.record(
+                "fleet", "migrate", fleet=self.fleet_id,
+                replica=sup.name, request_id=freq.request_id,
+                tokens_kept=len(req.output_token_ids),
+            )
+            moved.append(freq)
+        # HEAD of the queue, oldest first: migrated work has been
+        # waiting longest and must not queue behind fresh arrivals
+        self._pending.extendleft(reversed(moved))
+        if moved and self.journal is not None:
+            self.journal.flush()
+        return len(moved)
+
+    def _autoscale(self, now):
+        """One autoscaler tick (called once per scheduler step when
+        ``FleetConfig(scaling=)`` is attached): feed the decision
+        engine the pooled burn predicate, pending depth, and load;
+        execute its verdict through the degradable scale ops. The
+        cooldown clock is anchored on the DECISION, not its success —
+        a failing spawn must not be re-attempted every step."""
+        scaler = self._autoscaler
+        if scaler is None:
+            return None
+        plan = self.config.placement
+        decision = scaler.decide(
+            now,
+            burning=self.slo_burning(),
+            pending=sum(not f.done for f in self._pending),
+            live=self.size(),
+            capacity=plan.capacity(),
+            free_slice=self._free_slice_index() is not None,
+            load=sum(
+                s.load() for s in self.replicas
+                if s.engine is not None
+            ),
+        )
+        if decision == "up":
+            scaler.note_action(now)
+            self.scale_up(reason="autoscale")
+        elif decision == "down":
+            scaler.note_action(now)
+            self.scale_down(reason="autoscale-idle")
+        return decision
 
     # -- scheduler internals -------------------------------------------------
     def _sup_or_none(self, name):
@@ -973,6 +1399,8 @@ class Fleet:
         # calls can't consume the fresh-degraded admission gate
         for sup in self.replicas:
             sup.observe_errors()
+        if self._autoscaler is not None:
+            self._autoscale(time.perf_counter())
         self._expire_pending()
         self._dispatch_pending()
         if self.config.hedge_after_s is not None:

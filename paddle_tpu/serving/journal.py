@@ -302,6 +302,7 @@ class Journal:
         self._last_write = 0.0
         self._replayed = False
         self._append_warned = False
+        self._epoch = 0               # replica-epoch counter (R records)
         self.replay_report = None
         # counters (plain attributes; exported by the collector view)
         self.records_written = 0
@@ -419,6 +420,30 @@ class Journal:
         else:
             self._buffer.append({"t": "F", "rid": rid, "r": reason})
         self._urgent = True   # completions are durable before delivery
+
+    def epoch(self, op, replica=None):
+        """Buffer a replica-epoch record — the fleet brackets every
+        scaling action with these (``"shrink-begin"``/``"shrink-end"``
+        around a migration, one ``"scale-up"`` per spawn) so a replay
+        can tell a COMPLETED scaling op from one a crash interrupted.
+        Epochs are advisory markers, not the delivery contract: a
+        replayed mid-shrink crash is already exactly-once through
+        latest-ADMIT-wins (the migration re-ADMITs carry the emit
+        cursor), and epoch records make the interruption *observable*
+        (``replay_report["interrupted_ops"]``) so the fleet can
+        flight-record it. ``*-begin`` ops unclosed by a later
+        ``*-end`` for the same replica are reported as interrupted;
+        epoch numbering resumes past the journal's max after replay.
+        Urgent like every lifecycle record; returns the epoch number.
+        """
+        self._epoch += 1
+        rec = {"t": "R", "ep": self._epoch, "op": str(op),
+               "ts": time.time()}
+        if replica is not None:
+            rec["rep"] = str(replica)
+        self._buffer.append(rec)
+        self._urgent = True
+        return self._epoch
 
     def flush(self, force=False):
         """Write the buffered records (one ``write()``), group-fsync by
@@ -618,6 +643,8 @@ class Journal:
         generations = 0
         corrupt = torn = nrecords = 0
         seeds = []
+        epoch_max = 0
+        open_ops: dict = {}   # (op base, replica) -> epoch of begin
         for name in self.segments():
             spath = os.path.join(self.path, name)
             touched = self._touched.setdefault(name, set())
@@ -675,6 +702,14 @@ class Journal:
                     ent = entries.get(_key(rec["rid"]))
                     if ent is not None:
                         ent["fin"] = True
+                elif t == "R":
+                    epoch_max = max(epoch_max, rec.get("ep", 0))
+                    op = rec.get("op", "")
+                    rep = rec.get("rep")
+                    if op.endswith("-begin"):
+                        open_ops[(op[:-6], rep)] = rec.get("ep", 0)
+                    elif op.endswith("-end"):
+                        open_ops.pop((op[:-4], rep), None)
         self.generation = generations + 1
         if self.seed is not None and any(
             s is not None and s != self.seed for s in seeds
@@ -712,11 +747,21 @@ class Journal:
         if torn:
             self.torn_tails += torn
         self.replayed_requests += len(result)
+        # epoch numbering resumes past the dead incarnation's max, and
+        # any *-begin its crash left unclosed is surfaced so the fleet
+        # flight-records the interrupted scaling op (delivery itself
+        # is already exactly-once via latest-ADMIT-wins)
+        self._epoch = max(self._epoch, epoch_max)
+        interrupted = sorted(
+            f"{op}@{rep}" if rep is not None else op
+            for op, rep in open_ops
+        )
         self.replay_report = {
             "segments": len(self.segments()), "records": nrecords,
             "corrupt": corrupt, "torn": torn,
             "finished": sum(e["fin"] for e in entries.values()),
             "unfinished": len(result), "generation": self.generation,
+            "epochs": epoch_max, "interrupted_ops": interrupted,
         }
         _flight_record("replay", path=self.path, **self.replay_report)
         # recovery appends go to a fresh headered segment: the dead

@@ -1,0 +1,322 @@
+"""Pod-scale device placement and elastic scaling policy for the Fleet.
+
+``serving.sharding`` gave ONE replica a tensor-parallel slice; the
+Fleet stacked every replica on the same slice (``EngineConfig.devices``
+is fleet-wide), so dp=2 tp=2 "used" four chips while serving from two.
+This module is the missing placement half:
+
+  * :class:`PlacementPlan` — carves the visible device set into
+    DISJOINT per-replica TP slices (DP = replica count, per-replica
+    ``tp_degree``). Auto mode takes contiguous slices of ``tp_degree``
+    in device-id order; explicit mode pins exact id lists per slice.
+    Every way a plan cannot be realized — overlapping slices, more
+    replicas than slices (oversubscription), a slice width that does
+    not match the engine's ``tp_degree`` (indivisible) — raises ONE
+    named error, :class:`PlacementError`, at config construction time
+    instead of dying deep inside XLA mesh setup at first launch.
+
+  * :class:`ScalingPolicy` — the elasticity envelope and hysteresis
+    knobs: ``min_replicas``/``max_replicas`` bound the fleet size,
+    ``up_hold_s``/``down_hold_s`` are how long the scale-up signal
+    (sustained SLO burn, or pending depth >= ``up_pending``) and the
+    idle signal must persist before acting, and ``cooldown_s`` is the
+    refractory period after ANY scaling action — the three together
+    are what keeps the fleet from flapping.
+
+  * :class:`Autoscaler` — the pure decision engine the fleet ticks
+    once per scheduler step. It holds only timestamps (when the fleet
+    became hot / idle / last scaled) and returns ``"up"``, ``"down"``
+    or ``None``; executing the decision (spawning onto a free slice,
+    migrating work off a draining one) is the fleet's job, behind the
+    degradable ``fleet.scale`` fault site.
+
+See docs/serving.md "Elastic fleets" for the operator-facing
+walkthrough.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "Autoscaler", "PlacementError", "PlacementPlan", "ScalingPolicy",
+]
+
+
+class PlacementError(ValueError):
+    """A device-placement plan that cannot be realized on this host:
+    overlapping slices, oversubscribed replicas, or a slice width the
+    engine's ``tp_degree`` does not match. Raised at config
+    construction time — a bad plan must never reach XLA mesh setup."""
+
+
+class PlacementPlan:
+    """Disjoint per-replica TP slices over the visible device set.
+
+    Auto mode (``PlacementPlan(tp_degree=2)``) carves contiguous
+    slices of ``tp_degree`` device ids in visible-id order: slice i is
+    ids ``[i*tp, (i+1)*tp)``. Explicit mode
+    (``PlacementPlan(slices=[[0, 1], [4, 5]])``) pins exact id lists —
+    e.g. to keep slices inside ICI domains. Replica index -> slice
+    index is stable for the fleet's lifetime: a crash-restarted or
+    rolling-restarted replica rebuilds onto ITS slice, and scale-up
+    takes the lowest free slice.
+
+    ``total_devices`` overrides the visible-device probe (tests,
+    capacity planning off-host); ``None`` asks jax at validation time.
+    """
+
+    def __init__(self, tp_degree=None, slices=None, total_devices=None):
+        if slices is None and tp_degree is None:
+            raise PlacementError(
+                "PlacementPlan needs tp_degree= (auto-carved slices) "
+                "or slices= (explicit per-replica device ids)"
+            )
+        self.slices = None
+        if slices is not None:
+            self.slices = [list(s) for s in slices]
+            if not self.slices:
+                raise PlacementError(
+                    "PlacementPlan(slices=) is empty: a plan must "
+                    "provide at least one replica slice"
+                )
+            widths = {len(s) for s in self.slices}
+            if len(widths) != 1:
+                raise PlacementError(
+                    f"PlacementPlan(slices=) mixes slice widths "
+                    f"{sorted(widths)}: every replica shares one "
+                    f"EngineConfig, so every slice must have exactly "
+                    f"tp_degree devices"
+                )
+            inferred = widths.pop()
+            if tp_degree is not None and int(tp_degree) != inferred:
+                raise PlacementError(
+                    f"PlacementPlan slices are {inferred} device(s) "
+                    f"wide but tp_degree={tp_degree}: the slice width "
+                    f"IS the replica's tensor-parallel degree"
+                )
+            tp_degree = inferred
+            seen: dict = {}
+            for i, s in enumerate(self.slices):
+                for d in s:
+                    if not isinstance(d, int) or d < 0:
+                        raise PlacementError(
+                            f"PlacementPlan slice {i} names device "
+                            f"{d!r}: slices are lists of non-negative "
+                            f"integer device ids"
+                        )
+                    if d in seen:
+                        raise PlacementError(
+                            f"PlacementPlan slices overlap: device "
+                            f"{d} appears in slice {seen[d]} and "
+                            f"slice {i} — per-replica slices must be "
+                            f"disjoint"
+                        )
+                    seen[d] = i
+        self.tp_degree = int(tp_degree)
+        if self.tp_degree < 2:
+            # EngineConfig(devices=) refuses tp_degree == 1 (a
+            # single-chip engine runs on the process default device);
+            # the plan inherits the same floor rather than producing
+            # slices the engine cannot be placed on
+            raise PlacementError(
+                f"PlacementPlan needs tp_degree >= 2, got "
+                f"{self.tp_degree}: single-chip engines run on the "
+                f"process's default device and cannot be pinned "
+                f"(EngineConfig(devices=) requires tp_degree > 1)"
+            )
+        self._total = (
+            None if total_devices is None else int(total_devices)
+        )
+
+    def _visible(self):
+        """Total devices the plan is judged against (cached after the
+        first probe: the jax device set is fixed per process)."""
+        if self._total is None:
+            from .sharding import visible_device_ids
+
+            self._total = len(visible_device_ids())
+        return self._total
+
+    def capacity(self):
+        """How many replicas this plan can place (slice count)."""
+        if self.slices is not None:
+            return len(self.slices)
+        return self._visible() // self.tp_degree
+
+    def slice_ids(self, index):
+        """Device ids of slice ``index`` (replica index -> chips)."""
+        cap = self.capacity()
+        if not 0 <= index < cap:
+            raise PlacementError(
+                f"placement slice {index} does not exist: the plan "
+                f"holds {cap} slice(s) of {self.tp_degree} device(s)"
+            )
+        if self.slices is not None:
+            return list(self.slices[index])
+        start = index * self.tp_degree
+        return list(range(start, start + self.tp_degree))
+
+    def validate(self, num_replicas):
+        """Raise :class:`PlacementError` unless ``num_replicas``
+        replicas fit on this host — called at FleetConfig
+        construction so a bad plan fails before any engine exists."""
+        total = self._visible()
+        cap = self.capacity()
+        if num_replicas > cap:
+            raise PlacementError(
+                f"placement plan is oversubscribed: num_replicas="
+                f"{num_replicas} replicas x tp_degree="
+                f"{self.tp_degree} need "
+                f"{num_replicas * self.tp_degree} devices but the "
+                f"plan holds {cap} slice(s) over {total} visible "
+                f"device(s)"
+            )
+        if self.slices is not None:
+            bad = sorted(
+                d for s in self.slices for d in s if d >= total
+            )
+            if bad:
+                raise PlacementError(
+                    f"placement plan names device id(s) {bad} but "
+                    f"only {total} device(s) are visible (ids 0.."
+                    f"{total - 1})"
+                )
+        return self
+
+    def __repr__(self):
+        if self.slices is not None:
+            return f"PlacementPlan(slices={self.slices})"
+        return f"PlacementPlan(tp_degree={self.tp_degree})"
+
+
+class ScalingPolicy:
+    """Elasticity envelope + hysteresis for :class:`Autoscaler`.
+
+    ``min_replicas``/``max_replicas`` bound the fleet size
+    (``max_replicas=None`` means the placement plan's capacity). The
+    scale-up signal is sustained fleet-level SLO burn — the pooled
+    ``sustained_burn`` predicate PR 12 exports — or, when
+    ``up_pending`` is set, a parked backlog at/over that depth. The
+    scale-down signal is a fleet that could drop a replica without
+    feeling it: nothing parked, no burn, and total queued+running load
+    at/below ``down_load_per_replica`` per REMAINING replica (the
+    default 0.0 releases chips only when the fleet is fully idle).
+    Signals must hold for ``up_hold_s``/``down_hold_s`` and every
+    action is followed by ``cooldown_s`` of no action — hysteresis on
+    both edges, so burn that flickers at the threshold never flaps the
+    fleet."""
+
+    def __init__(self, min_replicas=1, max_replicas=None,
+                 up_hold_s=3.0, down_hold_s=30.0, cooldown_s=10.0,
+                 up_pending=None, down_load_per_replica=0.0):
+        if min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {min_replicas}"
+            )
+        if max_replicas is not None and max_replicas < min_replicas:
+            raise ValueError(
+                f"max_replicas={max_replicas} is below min_replicas="
+                f"{min_replicas}"
+            )
+        for nm, v in (("up_hold_s", up_hold_s),
+                      ("down_hold_s", down_hold_s),
+                      ("cooldown_s", cooldown_s)):
+            if v < 0:
+                raise ValueError(f"{nm} must be >= 0, got {v}")
+        if up_pending is not None and up_pending < 1:
+            raise ValueError(
+                f"up_pending must be >= 1 or None (burn-only scale "
+                f"up), got {up_pending}"
+            )
+        if down_load_per_replica < 0:
+            raise ValueError(
+                f"down_load_per_replica must be >= 0, got "
+                f"{down_load_per_replica}"
+            )
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = (
+            None if max_replicas is None else int(max_replicas)
+        )
+        self.up_hold_s = float(up_hold_s)
+        self.down_hold_s = float(down_hold_s)
+        self.cooldown_s = float(cooldown_s)
+        self.up_pending = (
+            None if up_pending is None else int(up_pending)
+        )
+        self.down_load_per_replica = float(down_load_per_replica)
+
+
+class Autoscaler:
+    """The hysteresis state machine over one fleet's scaling signals.
+
+    Pure host-side bookkeeping: :meth:`decide` is fed a snapshot
+    (burning? pending depth? live replicas? load?) once per fleet
+    step and returns ``"up"``, ``"down"`` or ``None``. It never
+    touches the fleet — the caller executes (and may fail to execute)
+    the decision, then reports back via :meth:`note_action` so the
+    cooldown clock starts even for a failed attempt (a spawn that
+    died must not be retried every step)."""
+
+    def __init__(self, policy):
+        if not isinstance(policy, ScalingPolicy):
+            raise TypeError(
+                f"Autoscaler needs a ScalingPolicy, got "
+                f"{type(policy).__name__}"
+            )
+        self.policy = policy
+        self._hot_since = None
+        self._idle_since = None
+        self._last_action = None
+
+    def note_action(self, now):
+        """Anchor the cooldown window and reset both hysteresis
+        clocks (the fleet just changed shape: signals must re-earn
+        their hold time against the new size)."""
+        self._last_action = now
+        self._hot_since = None
+        self._idle_since = None
+
+    def _cooling(self, now):
+        return (self._last_action is not None
+                and now - self._last_action < self.policy.cooldown_s)
+
+    def decide(self, now, *, burning, pending, live, capacity,
+               free_slice, load):
+        """One tick. ``burning`` is the pooled sustained-burn
+        predicate, ``pending`` the parked-request depth, ``live`` the
+        non-failed replica count, ``capacity`` the placement plan's
+        slice count, ``free_slice`` whether an unused slice exists,
+        ``load`` total queued+running requests across live engines."""
+        pol = self.policy
+        max_r = (
+            capacity if pol.max_replicas is None
+            else min(pol.max_replicas, capacity)
+        )
+        hot = burning or (
+            pol.up_pending is not None and pending >= pol.up_pending
+        )
+        idle = (
+            not hot and pending == 0
+            and load <= pol.down_load_per_replica * max(live - 1, 0)
+        )
+        if hot:
+            if self._hot_since is None:
+                self._hot_since = now
+        else:
+            self._hot_since = None
+        if idle:
+            if self._idle_since is None:
+                self._idle_since = now
+        else:
+            self._idle_since = None
+        if self._cooling(now):
+            return None
+        if live < pol.min_replicas and free_slice:
+            # below the floor (permanent failures shrank the fleet):
+            # recover capacity regardless of hold times
+            return "up"
+        if (hot and live < max_r and free_slice
+                and now - self._hot_since >= pol.up_hold_s):
+            return "up"
+        if (idle and live > pol.min_replicas
+                and now - self._idle_since >= pol.down_hold_s):
+            return "down"
+        return None

@@ -214,6 +214,7 @@ class _TenantState:
         self.shed_queue = 0
         self.output_tokens = 0
         self.restored = 0
+        self.migrated = 0
 
 
 class QoS:
@@ -344,6 +345,18 @@ class QoS:
         req._qos_vstart = start
         req._qos_vtag = st.vtime
 
+    def on_migrate(self, req):
+        """A scale-down / rolling restart moved this in-flight request
+        off its replica: count it, NOTHING else. Deliberately no
+        re-stamp (the admission-time ``_qos_vstart``/``_qos_vtag``
+        fair-queue tags must survive — a migrated request keeps its
+        place in the tenant's virtual timeline, it did not arrive
+        again), no ``received`` increment (shed/receive accounting
+        would see phantom traffic), and the rid stays in the tenant's
+        ``inflight`` set (it still is)."""
+        with self._lock:
+            self._state(getattr(req, "tenant", None)).migrated += 1
+
     def count_queue_shed(self, tenant):
         """The backend's bounded queue refused (fleet ``max_pending``
         / engine admission): counted per tenant so a saturated
@@ -444,6 +457,7 @@ class QoS:
                     "finished": st.finished,
                     "aborted": st.aborted,
                     "restored": st.restored,
+                    "migrated": st.migrated,
                     "shed_quota": st.shed_quota,
                     "shed_rate": st.shed_rate,
                     "shed_burn": st.shed_burn,
@@ -460,6 +474,7 @@ _TENANT_COUNTERS = {
     "finished": "paddle_tpu_serving_tenant_finished_total",
     "aborted": "paddle_tpu_serving_tenant_aborted_total",
     "restored": "paddle_tpu_serving_tenant_restored_total",
+    "migrated": "paddle_tpu_serving_tenant_migrated_total",
     "shed_quota": "paddle_tpu_serving_tenant_shed_quota_total",
     "shed_rate": "paddle_tpu_serving_tenant_shed_rate_total",
     "shed_burn": "paddle_tpu_serving_tenant_shed_burn_total",

@@ -56,7 +56,7 @@ from ..distributed.parallelize import ColWiseParallel, RowWiseParallel
 
 __all__ = [
     "TPSpec", "SERVING_TP_PLAN", "build_tp_mesh", "build_tp_spec",
-    "resolve_devices",
+    "resolve_devices", "visible_device_ids",
 ]
 
 # per-weight-key plan over the adapter's raw weight dict (keys are the
@@ -77,6 +77,15 @@ SERVING_TP_PLAN = {
 _LAYER_KEYS = (
     "ln1", "wq", "wk", "wv", "wo", "ln2", "wg", "wu", "wd",
 )
+
+
+def visible_device_ids():
+    """Ids of every device this process can place on — the universe a
+    ``serving.placement.PlacementPlan`` carves into per-replica
+    slices."""
+    import jax
+
+    return [d.id for d in jax.devices()]
 
 
 def resolve_devices(devices, tp_degree):

@@ -41,9 +41,18 @@ __all__ = ["ReplicaSupervisor"]
 
 class ReplicaSupervisor:
     def __init__(self, name, factory, restart_policy=None, max_restarts=2,
-                 analysis_check="error"):
+                 analysis_check="error", devices=None, slice_index=None):
         self.name = name
         self._factory = factory
+        # per-replica placement slice (serving.placement): the factory
+        # closure already bakes these into EngineConfig(devices=), so a
+        # crash restart — restart_policy.call(self._build, "restart")
+        # re-invoking the SAME factory — rebuilds onto THIS slice, not
+        # the fleet-wide shared list. Kept on the supervisor for
+        # observability (Fleet.health(), replica-device gauges) and
+        # slice bookkeeping (Fleet._free_slice_index).
+        self.devices = None if devices is None else list(devices)
+        self.slice_index = slice_index
         # restart attempts retry ANY exception: an engine build failure
         # has no transient/permanent signature the supervisor could
         # classify, and the restart budget bounds the total damage

@@ -1,0 +1,731 @@
+"""Elastic pod-scale fleet: placement plans, SLO-driven scaling, and
+journal-backed request migration (serving/placement.py + the fleet's
+scale_up/scale_down/_migrate_inflight machinery).
+
+Compile budget: every sharded engine in this module shares ONE
+module-scope compile-cache directory and one lean program family
+(single prefill bucket, no prefix cache / speculation / chunking), and
+the ``_warm`` fixture builds each placement slice's programs exactly
+once — every fleet after that warm-loads from disk. The SIGKILL chaos
+variant is marked ``slow``; the tier-1 tests stay in-process.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability.latency import SLOConfig
+from paddle_tpu.resilience import FaultSpec, faults
+from paddle_tpu.serving import (
+    Autoscaler,
+    PlacementError,
+    PlacementPlan,
+    ScalingPolicy,
+)
+
+COMPILE_COUNTERS = (
+    "prefill_compiles", "prefill_ext_compiles", "decode_compiles",
+    "verify_compiles", "cow_compiles",
+)
+
+SLICES = ([0, 1], [2, 3], [4, 5])
+
+
+def _ecfg(cache_dir, devices=None, **kw):
+    """The ONE lean sharded config family this module compiles."""
+    kw.setdefault("max_batch_slots", 4)
+    kw.setdefault("max_model_len", 32)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("prefill_buckets", [32])
+    kw.setdefault("tp_degree", 2)
+    kw.setdefault("seed", 0)
+    return serving.EngineConfig(
+        compile_cache=str(cache_dir), devices=devices, **kw
+    )
+
+
+def _compiles(engine):
+    return {c: getattr(engine.metrics, c) for c in COMPILE_COUNTERS}
+
+
+def _greedy(n=10):
+    return serving.SamplingParams(max_new_tokens=n)
+
+
+PROMPTS = [[1, 2, 3], [4, 5, 6], [7, 8, 9], [3, 1, 4, 1], [2, 7, 1, 8]]
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight_ring():
+    """The flight ring is process-global; the replica deaths and
+    scaling actions these tests inject must not leak stale events into
+    a later module's postmortem asserts (test_fleet counts failover
+    events in a dump)."""
+    yield
+    from paddle_tpu.observability.flight import get_flight_recorder
+
+    get_flight_recorder().clear()
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(LlamaConfig.tiny())
+
+
+@pytest.fixture(scope="module")
+def cache_dir(tmp_path_factory):
+    return tmp_path_factory.mktemp("elastic-cache")
+
+
+@pytest.fixture(scope="module")
+def warm(model, cache_dir):
+    """Compile + serialize the lean program family once per placement
+    slice; every later engine on these slices must warm-load with zero
+    fresh traces. Returns the oracle outputs for PROMPTS (greedy,
+    byte-parity reference for every migration test)."""
+    oracle = None
+    for devices in SLICES:
+        eng = serving.Engine(model, _ecfg(cache_dir, devices=devices))
+        if oracle is None:
+            outs = eng.generate(PROMPTS, _greedy())
+            oracle = {i: o.token_ids for i, o in enumerate(outs)}
+        del eng
+    return oracle
+
+
+class TestPlacementPlan:
+    def test_overlapping_slices_named_error(self):
+        with pytest.raises(PlacementError, match="overlap"):
+            PlacementPlan(slices=[[0, 1], [1, 2]], total_devices=8)
+
+    def test_oversubscribed_plan_named_error(self):
+        with pytest.raises(PlacementError, match="oversubscribed"):
+            PlacementPlan(tp_degree=2, total_devices=8).validate(5)
+
+    def test_indivisible_slice_widths_named_error(self):
+        with pytest.raises(PlacementError, match="widths"):
+            PlacementPlan(slices=[[0, 1], [2, 3, 4]])
+        with pytest.raises(PlacementError, match="tp_degree"):
+            PlacementPlan(tp_degree=4, slices=[[0, 1], [2, 3]])
+
+    def test_tp1_and_unknown_devices_refused(self):
+        with pytest.raises(PlacementError, match="tp_degree >= 2"):
+            PlacementPlan(tp_degree=1, total_devices=8)
+        with pytest.raises(PlacementError, match="visible"):
+            PlacementPlan(
+                slices=[[0, 1], [8, 9]], total_devices=8
+            ).validate(2)
+
+    def test_auto_carve_and_capacity(self):
+        plan = PlacementPlan(tp_degree=2, total_devices=8)
+        assert plan.capacity() == 4
+        assert [plan.slice_ids(i) for i in range(4)] == [
+            [0, 1], [2, 3], [4, 5], [6, 7],
+        ]
+        plan.validate(4)  # exactly full is fine
+        explicit = PlacementPlan(slices=[[0, 1], [4, 5]], total_devices=8)
+        assert explicit.capacity() == 2
+        assert explicit.tp_degree == 2
+        assert explicit.slice_ids(1) == [4, 5]
+        with pytest.raises(PlacementError, match="does not exist"):
+            explicit.slice_ids(2)
+
+    def test_fleet_config_validates_at_construction(self):
+        # the acceptance-criteria surface: a bad plan dies at
+        # FleetConfig construction with the ONE named error, before
+        # any engine or mesh exists
+        with pytest.raises(PlacementError, match="oversubscribed"):
+            serving.FleetConfig(
+                num_replicas=5,
+                placement=PlacementPlan(tp_degree=2, total_devices=8),
+            )
+        with pytest.raises(ValueError, match="requires placement"):
+            serving.FleetConfig(num_replicas=2, scaling=ScalingPolicy())
+
+    def test_scaling_policy_validation(self):
+        with pytest.raises(ValueError, match="min_replicas"):
+            ScalingPolicy(min_replicas=0)
+        with pytest.raises(ValueError, match="below min_replicas"):
+            ScalingPolicy(min_replicas=3, max_replicas=2)
+        with pytest.raises(TypeError, match="ScalingPolicy"):
+            Autoscaler(policy=object())
+
+    def test_autoscaler_hysteresis_and_cooldown(self):
+        pol = ScalingPolicy(
+            min_replicas=1, max_replicas=3, up_hold_s=5.0,
+            down_hold_s=20.0, cooldown_s=10.0,
+        )
+        a = Autoscaler(pol)
+        kw = dict(pending=0, live=2, capacity=4, free_slice=True, load=3)
+        # burn must HOLD for up_hold_s before an up fires
+        assert a.decide(0.0, burning=True, **kw) is None
+        assert a.decide(4.0, burning=True, **kw) is None
+        assert a.decide(5.0, burning=True, **kw) == "up"
+        a.note_action(5.0)
+        # cooldown swallows the decision; the hold clock restarts at
+        # the first post-action tick and may accrue DURING cooldown
+        assert a.decide(6.0, burning=True, **kw) is None
+        assert a.decide(14.0, burning=True, **kw) is None  # still cooling
+        assert a.decide(16.0, burning=True, **kw) == "up"
+        # a flicker resets the clock
+        a = Autoscaler(pol)
+        assert a.decide(0.0, burning=True, **kw) is None
+        assert a.decide(3.0, burning=False, **kw) is None
+        assert a.decide(5.0, burning=True, **kw) is None
+        # idle shrink respects min_replicas and its own hold
+        idle = dict(burning=False, pending=0, capacity=4,
+                    free_slice=True, load=0)
+        a = Autoscaler(pol)
+        assert a.decide(0.0, live=2, **idle) is None
+        assert a.decide(19.0, live=2, **idle) is None
+        assert a.decide(20.0, live=2, **idle) == "down"
+        a = Autoscaler(pol)
+        assert a.decide(0.0, live=1, **idle) is None
+        assert a.decide(100.0, live=1, **idle) is None  # at the floor
+
+
+class TestElasticFleet:
+    def test_replicas_spawn_on_disjoint_slices(self, model, cache_dir,
+                                               warm):
+        fleet = serving.Fleet(
+            model, _ecfg(cache_dir),
+            serving.FleetConfig(
+                num_replicas=2,
+                placement=PlacementPlan(tp_degree=2),
+            ),
+        )
+        ids = {
+            s.name: s.engine.tp.device_ids for s in fleet.replicas
+        }
+        assert ids == {"r0": [0, 1], "r1": [2, 3]}
+        assert not set(ids["r0"]) & set(ids["r1"])
+        for s in fleet.replicas:
+            # the slice rides the supervisor for observability and is
+            # baked into the factory for rebuilds
+            assert s.devices == s.engine.tp.device_ids
+            assert not any(v for v in _compiles(s.engine).values())
+        # satellite 2: placement + lifecycle state visible on /metrics
+        from paddle_tpu.observability import get_registry
+
+        text = get_registry().render_prometheus()
+        label = f'fleet="{fleet.fleet_id}"'
+        for rep, dev in (("r0", 0), ("r0", 1), ("r1", 2), ("r1", 3)):
+            assert (
+                f'paddle_tpu_fleet_replica_devices{{device="{dev}",'
+                f'{label},replica="{rep}"}} 1' in text
+            )
+        assert f'paddle_tpu_fleet_replicas{{{label},state="live"}} 2' in text
+        assert (
+            f'paddle_tpu_fleet_replicas{{{label},state="released"}} 0'
+            in text
+        )
+        assert fleet.health()["placement"] == {
+            "r0": [0, 1], "r1": [2, 3],
+        }
+        # tp mismatch between plan and engine config is config-time too
+        with pytest.raises(PlacementError, match="tensor-parallel"):
+            serving.Fleet(
+                model, serving.EngineConfig(),
+                serving.FleetConfig(
+                    num_replicas=1,
+                    placement=PlacementPlan(tp_degree=2),
+                ),
+            )
+
+    def test_crash_restart_lands_on_its_own_slice(self, model,
+                                                  cache_dir, warm):
+        # satellite 1 regression: the crash-restarted replica must
+        # rebuild onto ITS placement slice, not the fleet-wide list
+        fleet = serving.Fleet(
+            model, _ecfg(cache_dir),
+            serving.FleetConfig(
+                num_replicas=2,
+                placement=PlacementPlan(tp_degree=2),
+            ),
+        )
+        spec = FaultSpec(
+            RuntimeError("injected replica death"),
+            when=lambda c: (c.get("phase") == "step"
+                            and c.get("replica") == "r1"),
+            at=2,
+        )
+        with faults.inject({"serving.replica": spec}) as inj:
+            outs = fleet.generate(PROMPTS, _greedy())
+        assert inj.fired == {"serving.replica": 1}
+        for i, out in enumerate(outs):
+            assert out.token_ids == warm[i]
+        # settle the background restart, then check the slice
+        deadline = time.time() + 30.0
+        r1 = fleet.replica("r1")
+        while r1.status == "quarantined" and time.time() < deadline:
+            r1.join_restart(0.5)
+            fleet.step()
+        assert r1.status == "healthy"
+        assert r1.restarts == 1
+        assert r1.engine.tp.device_ids == [2, 3]   # ITS slice
+        assert r1.devices == [2, 3]
+        assert fleet.replica("r0").engine.tp.device_ids == [0, 1]
+        # and the rebuilt engine warm-loaded its slice's programs
+        assert not any(v for v in _compiles(r1.engine).values())
+
+    def test_scale_up_on_sustained_burn_zero_fresh_traces(
+            self, model, cache_dir, warm):
+        # the acceptance scenario: 2-replica tp=2 fleet under injected
+        # sustained SLO burn grows to 3 replicas on disjoint slices
+        # through the warm cache — compiles==0 on the new replica
+        slo = SLOConfig(
+            ttft_p99_ms=1.0, tpot_p99_ms=1.0, window_s=30.0,
+            min_samples=4,
+        )
+        fleet = serving.Fleet(
+            model, _ecfg(cache_dir, slo=slo),
+            serving.FleetConfig(
+                num_replicas=2,
+                placement=PlacementPlan(tp_degree=2),
+                scaling=ScalingPolicy(
+                    min_replicas=2, max_replicas=3, up_hold_s=0.0,
+                    down_hold_s=1e9, cooldown_s=1e9,
+                ),
+            ),
+        )
+        assert not fleet.slo_burning()
+        assert fleet._autoscale(0.0) is None  # quiet fleet: no action
+        # inject sustained burn: slow samples straight into the
+        # replica trackers (the same signal real traffic would feed)
+        for s in fleet.replicas:
+            for _ in range(6):
+                s.engine.slo.record(ttft_s=0.5)
+        assert fleet.slo_burning()
+        fleet.add_request(PROMPTS[0], _greedy(), request_id="b0")
+        fleet.step()   # the autoscaler tick rides the scheduler step
+        assert fleet.metrics.scale_ups == 1
+        assert [s.name for s in fleet.replicas] == ["r0", "r1", "r2"]
+        new = fleet.replica("r2")
+        assert new.status == "healthy"
+        assert new.engine.tp.device_ids == [4, 5]
+        covered = [s.engine.tp.device_ids for s in fleet.replicas]
+        assert sorted(map(tuple, covered)) == [(0, 1), (2, 3), (4, 5)]
+        # zero fresh traces: every program warm-loaded from the cache
+        assert not any(v for v in _compiles(new.engine).values()), (
+            _compiles(new.engine)
+        )
+        # cooldown: burn is still on, but no second action fires
+        assert fleet._autoscale(1.0) is None
+        assert fleet.metrics.scale_ups == 1
+        while fleet.has_unfinished():
+            fleet.step()
+        # scale-up is visible on the state gauge
+        from paddle_tpu.observability import get_registry
+
+        text = get_registry().render_prometheus()
+        assert (
+            f'paddle_tpu_fleet_replicas{{fleet="{fleet.fleet_id}",'
+            f'state="live"}} 3' in text
+        )
+
+    def test_shrink_migrates_inflight_with_byte_parity(
+            self, model, cache_dir, warm):
+        fleet = serving.Fleet(
+            model, _ecfg(cache_dir),
+            serving.FleetConfig(
+                num_replicas=2,
+                placement=PlacementPlan(tp_degree=2),
+            ),
+        )
+        freqs = [
+            fleet.add_request(p, _greedy(), request_id=f"m{i}")
+            for i, p in enumerate(PROMPTS)
+        ]
+        for _ in range(3):
+            fleet.step()
+        loaded = max(
+            (s for s in fleet.replicas if s.engine is not None),
+            key=lambda s: s.load(),
+        )
+        assert loaded.load() > 0   # there is work to migrate
+        released = fleet.scale_down(replica=loaded.name)
+        assert released is loaded
+        assert released.status == "released"
+        assert released.engine is None
+        assert fleet.metrics.scale_downs == 1
+        assert fleet.metrics.requests_migrated > 0
+        assert loaded.name not in {s.name for s in fleet.replicas}
+        done = {}
+        for _ in range(600):
+            for out in fleet.step():
+                done[out.request_id] = out
+            if len(done) == len(PROMPTS):
+                break
+        assert len(done) == len(PROMPTS)
+        for i in range(len(PROMPTS)):
+            # greedy byte-parity vs the uninterrupted oracle
+            assert done[f"m{i}"].token_ids == warm[i], f"m{i}"
+        assert all(f.done for f in freqs)
+        # the released slice is free again: a scale-up reuses it
+        sup = fleet.scale_up(reason="test")
+        assert sup is not None
+        assert sup.slice_index == released.slice_index
+        assert sup.engine.tp.device_ids == released.devices
+
+    def test_scale_ops_degrade_behind_fault_sites(self, model,
+                                                  cache_dir, warm):
+        fleet = serving.Fleet(
+            model, _ecfg(cache_dir),
+            serving.FleetConfig(
+                num_replicas=2,
+                placement=PlacementPlan(tp_degree=2),
+            ),
+        )
+        freqs = [
+            fleet.add_request(p, _greedy(), request_id=f"d{i}")
+            for i, p in enumerate(PROMPTS[:3])
+        ]
+        # a faulted scale-up/scale-down/placement never takes down
+        # serving traffic: the op returns None, counts, and the fleet
+        # keeps serving at its current size
+        with faults.inject({
+            "fleet.scale": FaultSpec(
+                RuntimeError("injected scale failure"),
+            ),
+        }) as inj:
+            assert fleet.scale_up() is None
+            assert fleet.scale_down() is None
+        assert inj.fired == {"fleet.scale": 2}
+        assert fleet.metrics.scale_errors == 2
+        assert fleet.metrics.scale_ups == 0
+        assert fleet.metrics.scale_downs == 0
+        with faults.inject({
+            "fleet.place": FaultSpec(
+                RuntimeError("injected placement failure"),
+            ),
+        }):
+            assert fleet.scale_up() is None
+        assert fleet.metrics.scale_errors == 3
+        assert len(fleet.replicas) == 2
+        outs = {}
+        while len(outs) < 3:
+            for o in fleet.step():
+                outs[o.request_id] = o
+        assert all(f.done for f in freqs)
+        for i in range(3):
+            assert outs[f"d{i}"].token_ids == warm[i]
+        # the last serving replica can never be shrunk away
+        fleet.scale_down(replica="r0")
+        assert fleet.scale_down() is None
+        assert fleet.size() >= 1
+
+    def test_migration_preserves_qos_tags_and_ttl(self, model,
+                                                  cache_dir, warm):
+        # satellite 6: migrated requests are RE-ADMITTED, not new —
+        # TTL anchored at arrival, tenant fair-queue tags survive
+        fleet = serving.Fleet(
+            model, _ecfg(cache_dir),
+            serving.FleetConfig(
+                num_replicas=2,
+                placement=PlacementPlan(tp_degree=2),
+            ),
+        )
+        qos = serving.QoS(serving.QoSConfig(
+            tenants={
+                "alpha": serving.TenantPolicy(weight=2.0),
+                "beta": serving.TenantPolicy(weight=1.0),
+            },
+            default_tenant="alpha",
+        ))
+        qos.attach(fleet)
+        freqs = {}
+        for tenant in ("alpha", "beta"):
+            for i, p in enumerate(PROMPTS[:2]):
+                freqs[f"{tenant}-{i}"] = fleet.add_request(
+                    p, serving.SamplingParams(
+                        max_new_tokens=10, ttl_s=300.0,
+                    ),
+                    request_id=f"{tenant}-{i}", tenant=tenant,
+                )
+        for _ in range(2):
+            fleet.step()
+        before = {
+            rid: (f.request.tenant, f.request._qos_vtag,
+                  f.request._qos_vstart, f.request.arrival_time,
+                  f.request.deadline)
+            for rid, f in freqs.items()
+        }
+        received = {
+            t: qos.snapshot()[t]["received"] for t in ("alpha", "beta")
+        }
+        loaded = max(
+            (s for s in fleet.replicas if s.engine is not None),
+            key=lambda s: s.load(),
+        )
+        assert fleet.scale_down(replica=loaded.name) is not None
+        moved = fleet.metrics.requests_migrated
+        assert moved > 0
+        after = {
+            rid: (f.request.tenant, f.request._qos_vtag,
+                  f.request._qos_vstart, f.request.arrival_time,
+                  f.request.deadline)
+            for rid, f in freqs.items()
+        }
+        # identity, fair-queue stamps, and clocks all survive the move
+        assert after == before
+        snap = qos.snapshot()
+        for t in ("alpha", "beta"):
+            # received counted ONCE per request — migration is not a
+            # new arrival (and sheds stay at zero)
+            assert snap[t]["received"] == received[t]
+            assert snap[t]["shed_queue"] == 0
+        assert sum(
+            snap[t]["migrated"] for t in ("alpha", "beta")
+        ) == moved
+        done = {}
+        for _ in range(600):
+            for out in fleet.step():
+                done[out.request_id] = out
+            if len(done) == len(freqs):
+                break
+        assert len(done) == len(freqs)
+        for tenant in ("alpha", "beta"):
+            for i in range(2):
+                assert done[f"{tenant}-{i}"].token_ids == warm[i]
+        for t in ("alpha", "beta"):
+            assert qos.snapshot()[t]["finished"] == 2
+
+    def test_rolling_restart_migrates_instead_of_draining(
+            self, model, cache_dir, warm):
+        fleet = serving.Fleet(
+            model, _ecfg(cache_dir),
+            serving.FleetConfig(
+                num_replicas=2,
+                placement=PlacementPlan(tp_degree=2),
+            ),
+        )
+        freqs = [
+            fleet.add_request(p, _greedy(), request_id=f"rr{i}")
+            for i, p in enumerate(PROMPTS)
+        ]
+        for _ in range(2):
+            fleet.step()
+        engine_ids = {
+            s.name: s.engine.engine_id for s in fleet.replicas
+        }
+        fleet.rolling_restart(min_available=1)
+        assert fleet.metrics.restarts == 2
+        # in-flight work was migrated, not waited out: both replicas
+        # rebuilt (fresh engines) on their own slices
+        for s in fleet.replicas:
+            assert s.status == "healthy"
+            assert s.engine.engine_id != engine_ids[s.name]
+            assert s.engine.tp.device_ids == s.devices
+        assert fleet.metrics.requests_migrated > 0
+        done = {}
+        for _ in range(600):
+            for out in fleet.step():
+                done[out.request_id] = out
+            if len(done) == len(PROMPTS):
+                break
+        assert all(f.done for f in freqs)
+        for i in range(len(PROMPTS)):
+            assert done[f"rr{i}"].token_ids == warm[i]
+
+
+class TestMidShrinkCrash:
+    def test_inprocess_crash_replay_exactly_once(self, model,
+                                                 cache_dir, tmp_path,
+                                                 warm):
+        """Tier-1 (compile-lean) variant of the SIGKILL chaos test:
+        the shrink is cut short after the migration re-ADMITs are
+        durable but before the shrink-end epoch record — the replayed
+        journal must deliver every request exactly once, byte-parity,
+        and report the interrupted op."""
+        jdir = str(tmp_path / "wal")
+        fleet = serving.Fleet(
+            model, _ecfg(cache_dir),
+            serving.FleetConfig(
+                num_replicas=2,
+                placement=PlacementPlan(tp_degree=2),
+                journal_dir=jdir,
+            ),
+        )
+        for i, p in enumerate(PROMPTS):
+            fleet.add_request(p, _greedy(), request_id=f"x{i}")
+        delivered = {}
+        for _ in range(4):
+            for out in fleet.step():
+                delivered[out.request_id] = out.token_ids
+        loaded = max(
+            (s for s in fleet.replicas if s.engine is not None),
+            key=lambda s: s.load(),
+        )
+        assert loaded.load() > 0
+        # crash mid-shrink: begin + migration written and flushed,
+        # shrink-end never reached (the exact window scale_down's
+        # epoch bracket exists to expose)
+        fleet.journal.epoch("shrink-begin", replica=loaded.name)
+        migrated = fleet._migrate_inflight(loaded)
+        assert migrated > 0
+        fleet.journal.flush(force=True)
+        del fleet   # the "crash": no close, no shrink-end
+        replay = serving.Fleet(
+            model, _ecfg(cache_dir),
+            serving.FleetConfig(
+                num_replicas=2,
+                placement=PlacementPlan(tp_degree=2),
+                journal_dir=jdir,
+            ),
+        )
+        report = replay.journal.replay_report
+        assert report["interrupted_ops"] == [f"shrink@{loaded.name}"]
+        assert report["epochs"] >= 1
+        # zero fresh traces through the whole recovery
+        for s in replay.replicas:
+            assert not any(v for v in _compiles(s.engine).values())
+        assert replay.metrics.journal_replayed == len(PROMPTS) - len(
+            delivered
+        )
+        recovered = {}
+        for _ in range(600):
+            for out in replay.step():
+                assert out.request_id not in delivered, (
+                    "request served twice across the crash"
+                )
+                assert out.request_id not in recovered
+                recovered[out.request_id] = out.token_ids
+            if not replay.has_unfinished():
+                break
+        # migrated ∪ finished == every request, each exactly once,
+        # byte-identical to the uninterrupted oracle
+        union = {**delivered, **recovered}
+        assert sorted(union) == [f"x{i}" for i in range(len(PROMPTS))]
+        for i in range(len(PROMPTS)):
+            assert union[f"x{i}"] == warm[i], f"x{i}"
+
+
+# -- slow SIGKILL chaos variant ----------------------------------------------
+
+_CHAOS_BOOTSTRAP = """\
+import json, sys, importlib
+import jax
+jax.config.update("jax_platforms", "cpu")
+mod, fn = sys.argv[1].split(":")
+f = getattr(importlib.import_module(mod), fn)
+f(*json.loads(sys.argv[2]))
+print("RESULT::done")
+"""
+
+
+def _chaos_child(journal_dir, cache_dir):
+    """Child body: journaled 2-replica placed fleet, mid-flight work,
+    then SIGKILL the whole process from inside ``Journal.epoch`` at
+    the shrink-end record — after the migration re-ADMITs are durable,
+    before the bracket closes. Prints DELIVERED:: lines so the parent
+    knows which outputs the client already saw."""
+    import paddle_tpu as paddle
+    from paddle_tpu import serving
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import PlacementPlan
+    from paddle_tpu.serving.journal import Journal
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    fleet = serving.Fleet(
+        model, _ecfg(cache_dir),
+        serving.FleetConfig(
+            num_replicas=2,
+            placement=PlacementPlan(tp_degree=2),
+            journal_dir=journal_dir,
+        ),
+    )
+    delivered = {}
+    for i, p in enumerate(PROMPTS):
+        fleet.add_request(p, _greedy(), request_id=f"k{i}")
+    for _ in range(4):
+        for out in fleet.step():
+            delivered[out.request_id] = out.token_ids
+    print("DELIVERED::" + json.dumps(delivered), flush=True)
+    real_epoch = Journal.epoch
+
+    def killing_epoch(self, op, replica=None):
+        if op == "shrink-end":
+            # the migration's re-ADMITs were flushed inside
+            # _migrate_inflight; dying here leaves the bracket open
+            os.kill(os.getpid(), signal.SIGKILL)
+        return real_epoch(self, op, replica)
+
+    Journal.epoch = killing_epoch
+    loaded = max(
+        (s for s in fleet.replicas if s.engine is not None),
+        key=lambda s: s.load(),
+    )
+    fleet.scale_down(replica=loaded.name)
+    raise AssertionError("scale_down survived the SIGKILL")
+
+
+@pytest.mark.slow
+def test_sigkill_mid_scale_down_replays_exactly_once(
+        model, cache_dir, tmp_path, warm):
+    jdir = str(tmp_path / "chaos-wal")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (os.path.dirname(tests_dir), tests_dir,
+                    env.get("PYTHONPATH", "")) if p
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHAOS_BOOTSTRAP,
+         "test_elastic:_chaos_child",
+         json.dumps([jdir, str(cache_dir)])],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=tests_dir,
+    )
+    assert proc.returncode == -signal.SIGKILL, (
+        f"child was supposed to die by SIGKILL (rc={proc.returncode})"
+        f"\n--- stdout ---\n{proc.stdout}"
+        f"\n--- stderr ---\n{proc.stderr}"
+    )
+    delivered = None
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("DELIVERED::"):
+            delivered = json.loads(line[len("DELIVERED::"):])
+            break
+    assert delivered is not None, proc.stdout
+    # replay in THIS process (same 8-device mesh, same warm cache):
+    # the journal carries the mid-shrink migration re-ADMITs and an
+    # unclosed shrink-begin
+    replay = serving.Fleet(
+        model, _ecfg(cache_dir),
+        serving.FleetConfig(
+            num_replicas=2,
+            placement=PlacementPlan(tp_degree=2),
+            journal_dir=jdir,
+        ),
+    )
+    report = replay.journal.replay_report
+    assert len(report["interrupted_ops"]) == 1
+    assert report["interrupted_ops"][0].startswith("shrink@")
+    for s in replay.replicas:
+        # zero fresh traces: the chaos run's cache warms the recovery
+        assert not any(v for v in _compiles(s.engine).values())
+    recovered = {}
+    for _ in range(600):
+        for out in replay.step():
+            assert out.request_id not in delivered, (
+                "request served twice across the SIGKILL"
+            )
+            assert out.request_id not in recovered
+            recovered[out.request_id] = out.token_ids
+        if not replay.has_unfinished():
+            break
+    union = {**delivered, **recovered}
+    assert sorted(union) == [f"k{i}" for i in range(len(PROMPTS))]
+    for i in range(len(PROMPTS)):
+        assert union[f"k{i}"] == warm[i], f"k{i}"

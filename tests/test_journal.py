@@ -313,6 +313,54 @@ class TestJournalUnit:
         assert SamplingParams.from_dict(d).to_dict() == p.to_dict()
 
 
+class TestReplicaEpochs:
+    """Replica-epoch ("R") records: the fleet brackets scaling ops with
+    them so a replay can tell completed from interrupted ops. They are
+    advisory — request delivery rides latest-ADMIT-wins regardless."""
+
+    def test_unclosed_begin_reported_interrupted(self, tmp_path):
+        j = Journal(str(tmp_path / "wal"))
+        j.admit(_req("a"))
+        assert j.epoch("shrink-begin", replica="r0") == 1
+        j.flush()
+        j2 = Journal(str(tmp_path / "wal"))
+        [e] = j2.replay()
+        assert e.rid == "a"   # R records never disturb request replay
+        assert j2.replay_report["epochs"] == 1
+        assert j2.replay_report["interrupted_ops"] == ["shrink@r0"]
+
+    def test_closed_bracket_is_clean(self, tmp_path):
+        j = Journal(str(tmp_path / "wal"))
+        j.epoch("shrink-begin", replica="r0")
+        j.epoch("shrink-end", replica="r0")
+        j.epoch("scale-up")   # unbracketed one-shot op, never "open"
+        j.flush()
+        j2 = Journal(str(tmp_path / "wal"))
+        assert j2.replay() == []
+        assert j2.replay_report["epochs"] == 3
+        assert j2.replay_report["interrupted_ops"] == []
+
+    def test_per_replica_bracket_pairing(self, tmp_path):
+        # r0's end must not close r1's begin
+        j = Journal(str(tmp_path / "wal"))
+        j.epoch("restart-begin", replica="r0")
+        j.epoch("restart-begin", replica="r1")
+        j.epoch("restart-end", replica="r0")
+        j.flush()
+        j2 = Journal(str(tmp_path / "wal"))
+        j2.replay()
+        assert j2.replay_report["interrupted_ops"] == ["restart@r1"]
+
+    def test_epoch_numbering_resumes_after_replay(self, tmp_path):
+        j = Journal(str(tmp_path / "wal"))
+        j.epoch("scale-up")
+        j.epoch("scale-up")
+        j.flush()
+        j2 = Journal(str(tmp_path / "wal"))
+        j2.replay()
+        assert j2.epoch("shrink-begin", replica="r1") == 3
+
+
 class TestEngineRecovery:
     def test_crash_replay_byte_identical(self, model, ref, tmp_path):
         jdir = str(tmp_path / "wal")
