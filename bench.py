@@ -1480,6 +1480,12 @@ def bench_analysis(paddle, on_tpu):
         max_batch_slots=8 if on_tpu else 2,
         max_model_len=512 if on_tpu else 32,
         page_size=16 if on_tpu else 8,
+        # the full 7-program family: prefill_ext per bucket + the COW
+        # copy + the speculative verify join decode + prefill — what
+        # the L3 compiled-family number below actually sweeps
+        enable_prefix_cache=True,
+        prefill_chunk_tokens=256 if on_tpu else 16,
+        speculate_tokens=2,
     ))
     report = eng.check_decode(mode="error")  # warm (imports, caches)
     t0 = time.perf_counter()
@@ -1491,6 +1497,26 @@ def bench_analysis(paddle, on_tpu):
     print(json.dumps({
         "metric": "analysis_decode_check_ms",
         "value": round(dt_ms, 1),
+        "unit": "ms",
+    }))
+    # L3 (census + per-chip memory) over the whole program family:
+    # the first call pays the isolated AOT compiles and memoizes the
+    # summaries; the steady-state number is rule re-evaluation over
+    # stored summaries — what EVERY later gate (and a warm restart)
+    # pays. Both are reported; the steady-state one is the metric.
+    t0 = time.perf_counter()
+    eng.check_compiled_programs()  # cold: compiles + extracts
+    cold_ms = (time.perf_counter() - t0) * 1e3
+    t0 = time.perf_counter()
+    r3 = eng.check_compiled_programs()
+    l3_ms = (time.perf_counter() - t0) * 1e3
+    progs = len(eng.metrics.program_bytes)
+    log(f"[analysis] compiled-family check: {l3_ms:.1f}ms warm / "
+        f"{cold_ms:.0f}ms cold ({progs} programs, "
+        f"{len(r3.findings)} findings)")
+    print(json.dumps({
+        "metric": "analysis_compiled_check_ms",
+        "value": round(l3_ms, 1),
         "unit": "ms",
     }))
     return dt_ms

@@ -6,31 +6,46 @@ host syncs on the hot path. This package makes them checkable BEFORE
 runtime — the jaxpr-native analogue of the reference's PIR verification
 passes (shape/dtype checks, inplace/aliasing passes).
 
-Two levels:
+Three levels:
 
-  * ``analysis.check(fn, *args)`` — trace (never execute) and run
+  * L1 ``analysis.check(fn, *args)`` — trace (never execute) and run
     pluggable passes over the closed jaxpr: retrace hazards, dtype
     drift, host-sync points, const bloat, donation misuse, dead outputs.
-  * ``python -m paddle_tpu.analysis --self`` — AST trace-safety lint
+  * L2 ``python -m paddle_tpu.analysis --self`` — AST trace-safety lint
     over the framework's own source (broad excepts, nondeterminism and
-    global mutation reachable from traced regions), enforced as a tier-1
-    CI gate.
+    global mutation reachable from traced regions, unlocked shared
+    mutation across thread roots, falsy-zero ``or`` guards), enforced
+    as a tier-1 CI gate. Exit codes 0/1/2 (clean/findings/usage).
+  * L3 ``analysis.check_compiled(fn_or_lowered, *args)`` — passes over
+    the LOWERED AND COMPILED program: SPMD collective census
+    (``unexpected-collective``/``resharding-copy``) and the per-device
+    memory budget gate (``memory-budget``), from the optimized HLO and
+    ``compiled.memory_analysis()``. Nothing executes.
 
 Choke points: ``jit.to_static(..., check="warn"|"error")`` analyzes on
-first call per signature; ``serving.Engine.check_decode()`` asserts the
-decode step is free of host-sync/retrace findings (strengthening the
-compile-count probe); ``tests/test_analysis.py::test_self_lint_clean``
-fails CI on new source violations. See docs/analysis.md for the rule
-catalog.
+first call per signature; ``serving.Engine.check_programs()`` runs
+L1 + L3 over the whole serving program family (with
+``EngineConfig(device_memory_budget=)`` refusing predicted-OOM configs
+at build); ``tests/test_analysis.py::test_self_lint_clean`` fails CI on
+new source violations. See docs/analysis.md for the rule catalog.
 """
 from .api import check, check_call, enforce
 from .astlint import lint_paths, lint_source, self_lint
+from .compiled import (
+    COMPILED_PASSES,
+    check_compiled,
+    program_summary,
+    summary_findings,
+)
 from .findings import AnalysisError, Finding, Report, Severity
 from .passes import PASSES, register_pass
 
 __all__ = [
     "check",
     "check_call",
+    "check_compiled",
+    "program_summary",
+    "summary_findings",
     "enforce",
     "Finding",
     "Report",
@@ -38,6 +53,7 @@ __all__ = [
     "AnalysisError",
     "register_pass",
     "PASSES",
+    "COMPILED_PASSES",
     "lint_source",
     "lint_paths",
     "self_lint",

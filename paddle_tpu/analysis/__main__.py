@@ -1,6 +1,18 @@
 """CLI: ``python -m paddle_tpu.analysis --self`` (the CI self-check
 gate) or ``python -m paddle_tpu.analysis path [path ...]`` to lint
-arbitrary files/trees. Exit code 0 iff no findings."""
+arbitrary files/trees.
+
+Exit codes (stable contract, docs/analysis.md):
+
+    0   clean — the lint ran and produced an EMPTY findings list
+    1   findings — the lint ran and produced one or more findings
+        (including ``parse-error`` findings for unreadable sources)
+    2   usage error — bad arguments (argparse's convention), nothing
+        was linted
+
+A clean run always prints the ``analysis: clean (0 findings)`` summary
+line, so "no output" can never be confused with "did not run".
+"""
 from __future__ import annotations
 
 import argparse
@@ -9,11 +21,18 @@ import sys
 from .astlint import lint_paths, package_root, self_lint
 from .findings import Report
 
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_USAGE = 2
+
 
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
-        description="trace-safety lint (level-2 AST rules)",
+        description=(
+            "trace-safety lint (level-2 AST rules); exit 0 clean, "
+            "1 findings, 2 usage error"
+        ),
     )
     parser.add_argument(
         "--self", action="store_true", dest="self_check",
@@ -26,10 +45,10 @@ def main(argv=None):
     elif args.paths:
         findings = lint_paths(args.paths, base=package_root())
     else:
-        parser.error("give --self or at least one path")
+        parser.error("give --self or at least one path")  # exits 2
     report = Report(findings)
     print(report.render())
-    return 1 if findings else 0
+    return EXIT_FINDINGS if findings else EXIT_CLEAN
 
 
 if __name__ == "__main__":

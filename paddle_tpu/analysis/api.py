@@ -28,11 +28,16 @@ __all__ = ["check", "check_call", "enforce"]
 
 def check_call(fn, args=(), kwargs=None, *, mode="collect", passes=None,
                static_argnums=(), donate_argnums=(),
-               const_bloat_bytes=1 << 20):
+               const_bloat_bytes=1 << 20, root=None):
     """Option-safe form of :func:`check`: the call's args/kwargs are
     passed EXPLICITLY, so a user function whose own kwargs are named
     ``mode``/``passes``/... cannot collide with analyzer options. The
-    ``to_static(check=)`` choke point uses this entry."""
+    ``to_static(check=)`` choke point uses this entry.
+
+    ``root``: entry-point label stamped on every finding
+    (``Finding.root``) — traced serving programs pass e.g.
+    ``"serving.decode"`` so a finding's ``file:line`` (usually deep in
+    an adapter body) and the program that reaches it both render."""
     if mode not in ("collect", "warn", "error"):
         raise ValueError(
             f'mode must be "collect", "warn" or "error", got {mode!r}'
@@ -68,15 +73,21 @@ def check_call(fn, args=(), kwargs=None, *, mode="collect", passes=None,
                 rule="trace-crash",
                 severity=Severity.WARNING,
                 message=f"analysis trace crashed: {e!r}",
+                root=root,
             ))
         return report
     ctx = AnalysisContext(trace=tr, const_bloat_bytes=const_bloat_bytes)
     report.extend(run_passes(ctx, mode=mode, passes=passes))
+    if root is not None:
+        for f in report.findings:
+            if f.root is None:
+                f.root = root
     return report
 
 
 def check(fn, *args, mode="collect", passes=None, static_argnums=(),
-          donate_argnums=(), const_bloat_bytes=1 << 20, **kwargs):
+          donate_argnums=(), const_bloat_bytes=1 << 20, root=None,
+          **kwargs):
     """Trace ``fn(*args, **kwargs)`` (no execution) and run the analysis
     passes; returns a ``Report`` of structured findings.
 
@@ -89,7 +100,7 @@ def check(fn, *args, mode="collect", passes=None, static_argnums=(),
     return check_call(
         fn, args, kwargs, mode=mode, passes=passes,
         static_argnums=static_argnums, donate_argnums=donate_argnums,
-        const_bloat_bytes=const_bloat_bytes,
+        const_bloat_bytes=const_bloat_bytes, root=root,
     )
 
 

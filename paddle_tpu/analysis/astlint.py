@@ -23,6 +23,26 @@ Rules:
     global-mutation    ``global`` declaration inside a traced-reachable
                        function: module state mutated at trace time, not
                        per execution
+    unlocked-shared-mutation  a ``self.attr`` assignment reachable from
+                       MORE THAN ONE thread root of a threaded class
+                       (a method passed to ``threading.Thread(target=
+                       self...)`` is one root; the class's public
+                       methods — the caller's thread — are another)
+                       without a ``with self._lock`` guard around the
+                       write. Reachability reuses the same-module call
+                       graph below. Writes in ``__init__`` (pre-thread)
+                       and classes that spawn no threads are exempt;
+                       reads are deliberately not tracked (precision
+                       over recall).
+    falsy-zero-guard   ``x or default`` where ``x`` is a timestamp /
+                       counter / size that legitimately holds 0 —
+                       either named like one (``since``/``now``/
+                       ``deadline``/``*_ts``/``*_at``/``*_time``...)
+                       or assigned from ``time.*()`` / ``len()`` in the
+                       same function. ``0 or default`` silently takes
+                       the default: the PR 17 autoscaler hysteresis bug
+                       (``since or now`` resetting a hold window every
+                       probe). Use ``x if x is not None else default``.
 
 "Traced region" is approximated conservatively (precision over recall):
 roots are functions decorated with ``to_static``/``jit``/``jax.jit``/
@@ -60,6 +80,16 @@ _ROOT_PREFIXES = (
 )
 _TIME_FNS = {"time", "time_ns", "monotonic", "monotonic_ns",
              "perf_counter", "perf_counter_ns"}
+# attribute names that look like synchronization primitives: writes
+# under `with self._lock:` (or any *lock*/*mutex*/*cond* name) count as
+# guarded, and the primitives themselves are never "shared mutations"
+_LOCK_NAME_RE = re.compile(r"lock|mutex|cond", re.IGNORECASE)
+# value names that legitimately hold 0: timestamps, counters, sizes —
+# the `x or default` falsy trap (falsy-zero-guard)
+_FALSY_ZERO_NAME_RE = re.compile(
+    r"(^|_)(since|now|ts|t0|deadline|elapsed)($|_)"
+    r"|_(at|time|started|seen|count|bytes|size)$"
+)
 
 
 def _allowed(lines, lineno, rule, end=None):
@@ -337,6 +367,196 @@ def _traced_rules(mod, relpath, lines, filename):
             )
 
 
+def _thread_targets(mod, cls_name):
+    """Methods of ``cls_name`` passed as ``threading.Thread(target=
+    self.<m>)`` anywhere in the class — each is one thread root."""
+    methods = mod.classes.get(cls_name, {})
+    targets = set()
+    for name in methods.values():
+        node = mod.functions.get(name)
+        if node is None:
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            is_thread = (
+                (isinstance(f, ast.Name) and f.id == "Thread")
+                or (isinstance(f, ast.Attribute) and f.attr == "Thread")
+            )
+            if not is_thread:
+                continue
+            for kw in sub.keywords:
+                if (kw.arg == "target"
+                        and isinstance(kw.value, ast.Attribute)
+                        and isinstance(kw.value.value, ast.Name)
+                        and kw.value.value.id == "self"
+                        and kw.value.attr in methods):
+                    targets.add(kw.value.attr)
+    return targets
+
+
+def _self_assignments(node):
+    """(attr, lineno, guarded) for every ``self.X = / op=`` statement in
+    one method, where guarded means lexically inside a ``with`` whose
+    context mentions a lock-named attribute."""
+    out = []
+
+    def visit(n, guarded):
+        if isinstance(n, ast.With):
+            g = guarded or any(
+                _LOCK_NAME_RE.search(name)
+                for item in n.items
+                for name in _names_in(item.context_expr)
+            )
+            for child in n.body:
+                visit(child, g)
+            return
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return  # nested defs run on whatever thread calls them
+        targets = []
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        for t in targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and not _LOCK_NAME_RE.search(t.attr)):
+                out.append((t.attr, n.lineno, guarded))
+        for child in ast.iter_child_nodes(n):
+            visit(child, guarded)
+
+    for stmt in node.body:
+        visit(stmt, False)
+    return out
+
+
+def _shared_mutation(mod, lines, filename):
+    """unlocked-shared-mutation: per threaded class, find ``self.X``
+    writes reachable from two or more thread roots where at least one
+    write site is outside a lock guard."""
+    for cls_name, methods in mod.classes.items():
+        targets = _thread_targets(mod, cls_name)
+        if not targets:
+            continue  # class spawns no threads: single-threaded by lint
+        # roots: one per Thread target + ONE for the calling thread
+        # (every public method); __init__ runs before any thread starts
+        roots = {f"thread:{t}": {methods[t]} for t in targets}
+        callers = {
+            qual for name, qual in methods.items()
+            if not name.startswith("_") and name not in targets
+        }
+        if callers:
+            roots["callers"] = callers
+        reach = {
+            root: _reachable(mod, quals)
+            for root, quals in roots.items()
+        }
+        # attr -> {root ids} and the unguarded write sites
+        writer_roots: dict = {}
+        unguarded: dict = {}
+        for name, qual in methods.items():
+            if name == "__init__":
+                continue
+            node = mod.functions.get(qual)
+            if node is None:
+                continue
+            my_roots = {r for r, seen in reach.items() if qual in seen}
+            if not my_roots:
+                continue
+            for attr, lineno, guarded in _self_assignments(node):
+                writer_roots.setdefault(attr, set()).update(my_roots)
+                if not guarded:
+                    unguarded.setdefault(attr, []).append(
+                        (qual, lineno)
+                    )
+        for attr in sorted(writer_roots):
+            rts = writer_roots[attr]
+            if len(rts) < 2:
+                continue
+            for qual, lineno in unguarded.get(attr, []):
+                if _allowed(lines, lineno, "unlocked-shared-mutation"):
+                    continue
+                yield Finding(
+                    rule="unlocked-shared-mutation",
+                    severity=Severity.WARNING,
+                    message=(
+                        f"`self.{attr}` is written in `{qual}` without "
+                        f"a lock guard, but is reachable from "
+                        f"{len(rts)} thread roots of `{cls_name}` "
+                        f"({', '.join(sorted(rts))}): wrap the write "
+                        "in `with self._lock:` or annotate the benign "
+                        "site with `# analysis: "
+                        "allow(unlocked-shared-mutation) <reason>`"
+                    ),
+                    file=filename,
+                    line=lineno,
+                )
+
+
+def _falsy_zero(mod, lines, filename):
+    """falsy-zero-guard: ``x or default`` over values that legitimately
+    hold 0 (timestamps / counters / sizes)."""
+    for qual, node in mod.functions.items():
+        # names bound from time.*() or len() in this function: dataflow
+        # evidence the value is a timestamp/size even if named opaquely
+        zeroish = set()
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Assign)
+                    and len(sub.targets) == 1
+                    and isinstance(sub.targets[0], ast.Name)
+                    and isinstance(sub.value, ast.Call)):
+                continue
+            f = sub.value.func
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in mod.time_aliases
+                    and f.attr in _TIME_FNS):
+                zeroish.add(sub.targets[0].id)
+            elif isinstance(f, ast.Name) and f.id == "len":
+                zeroish.add(sub.targets[0].id)
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.BoolOp)
+                    and isinstance(sub.op, ast.Or)):
+                continue
+            left = sub.values[0]
+            if isinstance(left, ast.Name):
+                name = left.id
+            elif isinstance(left, ast.Attribute):
+                name = left.attr
+            elif (isinstance(left, ast.Call)
+                    and isinstance(left.func, ast.Attribute)
+                    and isinstance(left.func.value, ast.Name)
+                    and left.func.value.id in mod.time_aliases
+                    and left.func.attr in _TIME_FNS):
+                name = f"{left.func.value.id}.{left.func.attr}()"
+            else:
+                continue
+            if not (name in zeroish or name.endswith("()")
+                    or _FALSY_ZERO_NAME_RE.search(name)):
+                continue
+            if _allowed(lines, sub.lineno, "falsy-zero-guard"):
+                continue
+            yield Finding(
+                rule="falsy-zero-guard",
+                severity=Severity.WARNING,
+                message=(
+                    f"`{name} or ...` treats 0 as missing, but "
+                    f"`{name}` is a timestamp/counter/size where 0 is "
+                    "a legitimate value — the `since or now` "
+                    "hysteresis bug shape; use "
+                    f"`{name} if {name} is not None else ...` (or "
+                    "annotate `# analysis: allow(falsy-zero-guard) "
+                    "<reason>`)"
+                ),
+                file=filename,
+                line=sub.lineno,
+            )
+
+
 def lint_source(text, filename="<string>", relpath=None):
     """Lint one source blob; returns a list of Findings. ``relpath`` is
     the package-relative path used for path-based trace roots."""
@@ -354,6 +574,8 @@ def lint_source(text, filename="<string>", relpath=None):
     findings = list(_broad_except(tree, lines, filename))
     mod = _Module(tree)
     findings.extend(_traced_rules(mod, relpath, lines, filename))
+    findings.extend(_shared_mutation(mod, lines, filename))
+    findings.extend(_falsy_zero(mod, lines, filename))
     findings.sort(key=lambda f: (f.line or 0))
     return findings
 

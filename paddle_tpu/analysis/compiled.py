@@ -1,0 +1,392 @@
+"""Level-3 lint: passes over LOWERED AND COMPILED programs.
+
+The jaxpr passes (L1) see what the user traced; this level sees what
+XLA actually built — after GSPMD partitioning, layout assignment and
+buffer allocation — which is where the expensive failure classes live:
+collectives the partitioner inserted silently, full-tensor re-shards,
+and a per-device footprint that only surfaces as RESOURCE_EXHAUSTED on
+a live chip.
+
+    report = analysis.check_compiled(fn_or_lowered, *abstract_args)
+    report.census    # {op: {"count", "bytes", "max_bytes"}}
+    report.memory    # {"argument", "output", "temp", ..., "peak"}
+
+Passes (each also usable over a stored summary — see
+:func:`summary_findings` — so a warm restart re-evaluates rules
+without re-extracting anything):
+
+    collective-census  parse the optimized-HLO text for
+                       ``all-reduce``/``all-gather``/``reduce-scatter``/
+                       ``collective-permute``/``all-to-all`` with result
+                       byte sizes. Emits ``unexpected-collective``
+                       (ERROR) when a program declared
+                       ``tp_numerics="exact"`` (or tp=1) contains a
+                       reduction-order-bearing collective (all-reduce /
+                       reduce-scatter — gathers are order-preserving
+                       data movement and expected under exact mode),
+                       and ``resharding-copy`` (WARNING) for a gather/
+                       permute moving >= ``reshard_bytes`` in one shot —
+                       the GSPMD full-tensor re-shard shape that bit the
+                       KV pool.
+    memory-budget      ``compiled.memory_analysis()`` per-device bytes:
+                       peak = argument + output - alias + temp +
+                       generated_code. Emits ``memory-budget`` (ERROR)
+                       when a budget is declared and predicted peak
+                       exceeds it.
+
+``mode`` follows :func:`analysis.check`: it controls how a CRASHING
+pass (or a failing compile) degrades — "collect" records a
+``pass-crash``/``compile-crash`` finding, "warn" warns, "error" raises.
+Rule findings themselves are always collected; callers enforce.
+Every pass invocation crosses the ``analysis.compiled`` fault site
+(docs/resilience.md), so tests can assert a crashing L3 pass degrades
+instead of killing an engine build.
+"""
+from __future__ import annotations
+
+import math
+import re
+import warnings
+
+from .findings import AnalysisError, Finding, Report, Severity
+
+__all__ = [
+    "check_compiled", "program_summary", "summary_findings",
+    "COLLECTIVE_OPS", "REDUCTION_OPS", "DEFAULT_RESHARD_BYTES",
+]
+
+#: HLO collective instruction kinds the census counts.
+COLLECTIVE_OPS = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+)
+#: The subset whose result depends on a cross-chip reduction ORDER —
+#: the ops exact-mode numerics promise to avoid.
+REDUCTION_OPS = frozenset({"all-reduce", "reduce-scatter"})
+
+#: Single-shot transfer size at/above which a gather/permute is
+#: reported as a probable GSPMD full-tensor re-shard.
+DEFAULT_RESHARD_BYTES = 8 << 20
+
+_ITEMSIZE = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1, "f8e5m2fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# `%name = <result-type> all-reduce(...)`; -start variants count, the
+# paired -done re-references the same transfer and must not
+_COLLECTIVE_RE = re.compile(
+    r"=\s*(?P<rtype>[^=]*?)\s*"
+    r"(?P<op>" + "|".join(COLLECTIVE_OPS) + r")(?P<phase>-start|-done)?\("
+)
+_SHAPE_RE = re.compile(r"(\w+)\[([0-9,]*)\]")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def _type_nbytes(rtype):
+    """Byte size of one HLO result-type string (tuples sum)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(rtype):
+        item = _ITEMSIZE.get(dtype)
+        if item is None:
+            continue  # token[] / opaque[] carry no data
+        sizes = [int(d) for d in dims.split(",") if d]
+        total += item * math.prod(sizes)
+    return total
+
+
+def hlo_collectives(text):
+    """Per-occurrence collective list from optimized-HLO text:
+    ``[{"op", "bytes", "source"}]`` (source = the op_name metadata XLA
+    kept, '' when the compiler inserted the op without provenance)."""
+    out = []
+    for line in text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if m is None or m.group("phase") == "-done":
+            continue
+        src = _OPNAME_RE.search(line)
+        out.append({
+            "op": m.group("op"),
+            "bytes": _type_nbytes(m.group("rtype")),
+            "source": src.group(1) if src else "",
+        })
+    return out
+
+
+def census_summary(occurrences):
+    """Aggregate per-occurrence collectives to the JSON-able census
+    stored with compile-cache artifacts."""
+    census = {}
+    for occ in occurrences:
+        entry = census.setdefault(
+            occ["op"], {"count": 0, "bytes": 0, "max_bytes": 0}
+        )
+        entry["count"] += 1
+        entry["bytes"] += occ["bytes"]
+        entry["max_bytes"] = max(entry["max_bytes"], occ["bytes"])
+    return census
+
+
+def memory_summary(compiled):
+    """Per-device byte budget of one compiled program, from
+    ``compiled.memory_analysis()``: argument/output/temp/alias/
+    generated-code sizes plus the derived ``peak`` (argument + output
+    - alias + temp + generated_code — aliased/donated buffers are
+    counted once). Returns None when the backend exposes no analysis."""
+    try:
+        stats = compiled.memory_analysis()
+    except Exception:  # analysis: allow(broad-except) backends without
+        # memory analysis (or older PJRT) degrade to "no summary"
+        return None
+    if stats is None:
+        return None
+    get = lambda name: int(
+        getattr(stats, f"{name}_size_in_bytes", 0) or 0
+    )
+    out = {
+        "argument": get("argument"),
+        "output": get("output"),
+        "temp": get("temp"),
+        "alias": get("alias"),
+        "generated_code": get("generated_code"),
+    }
+    out["peak"] = (
+        out["argument"] + out["output"] - out["alias"] + out["temp"]
+        + out["generated_code"]
+    )
+    return out
+
+
+def program_summary(compiled):
+    """The full JSON-able L3 record of one compiled program — what
+    ``Engine`` stores in the compile-cache artifact metadata so a warm
+    restart replays rule evaluation without re-extracting HLO or
+    re-running the memory analysis."""
+    try:
+        text = compiled.as_text()
+    except Exception:  # analysis: allow(broad-except) a backend that
+        # cannot render HLO text yields an empty census, not a crash
+        text = ""
+    return {
+        "census": census_summary(hlo_collectives(text or "")),
+        "memory": memory_summary(compiled),
+    }
+
+
+def _census_findings(ctx):
+    census = ctx.summary.get("census") or {}
+    findings = []
+    exact_declared = ctx.tp_numerics == "exact" or (
+        ctx.tp_numerics is None and ctx.tp_degree == 1
+    )
+    if exact_declared:
+        for op in sorted(REDUCTION_OPS & set(census)):
+            entry = census[op]
+            declared = (
+                f'tp_numerics="{ctx.tp_numerics}"'
+                if ctx.tp_numerics is not None
+                else f"tp_degree={ctx.tp_degree}"
+            )
+            findings.append(Finding(
+                rule="unexpected-collective",
+                severity=Severity.ERROR,
+                message=(
+                    f"{entry['count']} `{op}` op(s) "
+                    f"({entry['bytes']} bytes total) in a program "
+                    f"declared {declared}: reduction-order-bearing "
+                    "collectives break the bit-exact numerics "
+                    "contract — the partitioner summed partial "
+                    "products across chips"
+                ),
+                op=op,
+                root=ctx.program,
+            ))
+    for op in ("all-gather", "collective-permute"):
+        entry = census.get(op)
+        if entry and entry["max_bytes"] >= ctx.reshard_bytes:
+            findings.append(Finding(
+                rule="resharding-copy",
+                severity=Severity.WARNING,
+                message=(
+                    f"`{op}` moving {entry['max_bytes']} bytes in one "
+                    "shot — a GSPMD-inserted full-tensor re-shard "
+                    "(the pattern that re-gathered the KV pool); "
+                    "constrain the producer's sharding or raise "
+                    "`reshard_bytes` if the transfer is intended"
+                ),
+                op=op,
+                root=ctx.program,
+            ))
+    return findings
+
+
+def _memory_findings(ctx):
+    mem = ctx.summary.get("memory")
+    budget = ctx.device_memory_budget
+    if mem is None or budget is None:
+        return []
+    if mem["peak"] <= budget:
+        return []
+    parts = ", ".join(
+        f"{k}={mem[k]}" for k in
+        ("argument", "output", "temp", "generated_code", "alias")
+    )
+    return [Finding(
+        rule="memory-budget",
+        severity=Severity.ERROR,
+        message=(
+            f"program {ctx.program or '<compiled>'}: predicted "
+            f"per-chip peak {mem['peak']} bytes exceeds "
+            f"device_memory_budget={budget} ({parts}) — this config "
+            "would die with RESOURCE_EXHAUSTED at launch"
+        ),
+        root=ctx.program,
+    )]
+
+
+COMPILED_PASSES = {
+    "collective-census": _census_findings,
+    "memory-budget": _memory_findings,
+}
+
+
+class _Ctx:
+    def __init__(self, summary, program, tp_numerics, tp_degree,
+                 device_memory_budget, reshard_bytes):
+        self.summary = summary
+        self.program = program
+        self.tp_numerics = tp_numerics
+        self.tp_degree = tp_degree
+        self.device_memory_budget = device_memory_budget
+        self.reshard_bytes = reshard_bytes
+
+
+def summary_findings(summary, *, program=None, tp_numerics=None,
+                     tp_degree=None, device_memory_budget=None,
+                     reshard_bytes=DEFAULT_RESHARD_BYTES,
+                     mode="collect", passes=None):
+    """Run the L3 rule set over an (extracted or stored) program
+    summary. Pure host work — the path a warm-restarted engine takes
+    over summaries read back from compile-cache artifacts, so rules
+    stay enforced with zero re-analysis. Crash/degradation contract and
+    the ``analysis.compiled`` fault site are identical to
+    :func:`check_compiled`."""
+    from ..resilience import faults
+
+    findings = []
+    for name, fn in COMPILED_PASSES.items():
+        if passes is not None and name not in passes:
+            continue
+        ctx = _Ctx(summary, program, tp_numerics, tp_degree,
+                   device_memory_budget, reshard_bytes)
+        try:
+            faults.fire("analysis.compiled", rule=name, program=program)
+            findings.extend(fn(ctx))
+        except Exception as e:
+            # same isolation as the L1 passes: a crashing analyzer must
+            # never take down the caller (an engine BUILD crosses this
+            # in collect mode, so an L3 crash is never fatal there)
+            if mode == "error":
+                raise AnalysisError(
+                    f"compiled-analysis pass {name!r} crashed: {e!r}"
+                ) from e
+            if mode == "warn":
+                warnings.warn(
+                    f"compiled-analysis pass {name!r} crashed and was "
+                    f"skipped: {e!r}",
+                    stacklevel=2,
+                )
+            else:
+                findings.append(Finding(
+                    rule="pass-crash",
+                    severity=Severity.WARNING,
+                    message=(
+                        f"compiled-analysis pass {name!r} crashed: "
+                        f"{e!r}"
+                    ),
+                    root=program,
+                ))
+    return findings
+
+
+def _resolve_compiled(target, args, static_argnums, donate_argnums):
+    """target may be a ``jax.stages.Compiled``, a ``jax.stages.Lowered``
+    or a plain callable (jitted or not). Callables are wrapped in a
+    fresh function object before jitting, so the analysis lowering can
+    never warm (or pollute) the pjit cache a later real launch relies
+    on — the same isolation discipline as the L1 trace harness."""
+    import jax
+
+    if hasattr(target, "as_text") and hasattr(target, "memory_analysis"):
+        return target  # already compiled
+    if hasattr(target, "compile") and hasattr(target, "as_text"):
+        return target.compile()  # a Lowered
+    fn = target
+    wrapped = lambda *a: fn(*a)  # fresh object: isolated trace cache
+    jitted = jax.jit(
+        wrapped, static_argnums=static_argnums,
+        donate_argnums=donate_argnums,
+    )
+    return jitted.lower(*args).compile()
+
+
+def check_compiled(target, *args, mode="collect", passes=None,
+                   static_argnums=(), donate_argnums=(),
+                   tp_numerics=None, tp_degree=None,
+                   device_memory_budget=None, program=None,
+                   reshard_bytes=DEFAULT_RESHARD_BYTES):
+    """Lower + compile ``target`` (or take an already
+    lowered/compiled program) and run the L3 passes. Nothing executes
+    on device: compilation is ahead-of-time from the given (abstract
+    or concrete) arguments. Returns a :class:`Report` carrying
+    ``report.census`` and ``report.memory`` alongside the findings.
+
+    ``tp_numerics``/``tp_degree`` declare the numerics contract the
+    census judges against; ``device_memory_budget`` (bytes per device)
+    arms the memory gate; ``program`` labels findings' ``root``."""
+    if mode not in ("collect", "warn", "error"):
+        raise ValueError(
+            f'mode must be "collect", "warn" or "error", got {mode!r}'
+        )
+    report = Report()
+    report.census = {}
+    report.memory = None
+    try:
+        from ..observability import jit_events
+
+        with jit_events.suppress():
+            compiled = _resolve_compiled(
+                target, args, static_argnums, donate_argnums
+            )
+        summary = program_summary(compiled)
+    except Exception as e:
+        # compile failure degrades exactly like an L1 trace failure
+        if mode == "error":
+            raise AnalysisError(
+                f"analysis compile failed: {e!r}"
+            ) from e
+        if mode == "warn":
+            warnings.warn(
+                f"analysis compile failed and was skipped: {e!r}",
+                stacklevel=2,
+            )
+        else:
+            report.add(Finding(
+                rule="compile-crash",
+                severity=Severity.WARNING,
+                message=f"analysis compile crashed: {e!r}",
+                root=program,
+            ))
+        return report
+    report.census = summary["census"]
+    report.memory = summary["memory"]
+    report.extend(summary_findings(
+        summary, program=program, tp_numerics=tp_numerics,
+        tp_degree=tp_degree, device_memory_budget=device_memory_budget,
+        reshard_bytes=reshard_bytes, mode=mode, passes=passes,
+    ))
+    return report

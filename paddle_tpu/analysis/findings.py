@@ -39,6 +39,13 @@ class Finding:
     line:     1-indexed line in ``file``.
     op:       jaxpr primitive name for traced-program findings, None for
               AST findings.
+    root:     the entry point the finding was reached FROM (e.g.
+              ``"serving.decode"`` for an engine program, the program
+              tag for an L3 compiled-program finding). ``file:line``
+              names the offending call site — usually deep inside an
+              adapter or op body — while ``root`` names the program
+              that pulls it onto a hot path, so a rendered finding
+              carries both.
     """
 
     rule: str
@@ -47,6 +54,7 @@ class Finding:
     file: str | None = None
     line: int | None = None
     op: str | None = None
+    root: str | None = None
 
     def location(self):
         if self.file is None:
@@ -56,7 +64,11 @@ class Finding:
     def render(self):
         tag = self.severity.name.lower()
         ops = f" [{self.op}]" if self.op else ""
-        return f"{self.location()}: {tag}: {self.rule}{ops}: {self.message}"
+        via = f" (root: {self.root})" if self.root else ""
+        return (
+            f"{self.location()}: {tag}: {self.rule}{ops}{via}: "
+            f"{self.message}"
+        )
 
 
 @dataclass

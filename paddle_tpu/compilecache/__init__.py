@@ -298,6 +298,21 @@ class CompileCache:
         )
         return result
 
+    def load_meta(self, key):
+        """Metadata-only read: the artifact's meta dict or ``None``.
+        No blob I/O and no hit/miss accounting — this is the cheap
+        side-channel for sidecar metadata (an engine's stored L3
+        analysis summary on a warm restart), not an executable load."""
+        try:
+            meta = self.store.get_meta(key)
+        except Exception:
+            # analysis: allow(broad-except) metadata is best-effort —
+            # an unreadable meta only costs a re-analysis, never a crash
+            return None
+        if meta is not None and meta.get("env") != self.env:
+            return None
+        return meta
+
     def load_executable(self, key, name="", signature=""):
         """Load one serialized executable; ``None`` on any miss or
         damage (see :meth:`load_executable_bundle`)."""

@@ -223,6 +223,20 @@ class ArtifactStore:
             pass
         return meta, blobs
 
+    def get_meta(self, key):
+        """Metadata-only read: the artifact's meta dict, or ``None``
+        when the artifact is absent or its metadata is unreadable. No
+        blob I/O, no checksum pass, no LRU touch — the cheap path for
+        callers that only need sidecar metadata (e.g. a warm-restarting
+        engine reading a stored analysis summary without deserializing
+        the executable)."""
+        d = self._dir(key)
+        try:
+            with open(os.path.join(d, _META_FILE)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
     def contains(self, key):
         return os.path.isdir(self._dir(key))
 

@@ -108,7 +108,7 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
 
     rank = rank if rank is not None else int(
         os.environ.get("PADDLE_TRAINER_ID", "0"))
-    world_size = world_size or int(
+    world_size = world_size if world_size is not None else int(
         os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     master_endpoint = master_endpoint or os.environ.get(
         "PADDLE_MASTER", "127.0.0.1:29590")

@@ -56,6 +56,13 @@ docs/resilience.md):
                        rule=) — lets tests assert a crashing analyzer
                        degrades (check="warn") instead of killing the
                        caller
+    analysis.compiled  one compiled-program (L3) analysis pass
+                       invocation (context: rule=, program=) — a
+                       crashing census/memory pass degrades to a
+                       warned ``pass-crash`` finding in collect mode,
+                       so an engine build with
+                       ``device_memory_budget=`` set survives an L3
+                       crash instead of failing to construct
     obs.export         one observability exporter invocation (context:
                        what= "scrape"/"healthz"/"flight"/
                        "chrome_trace") — exporter/scrape failures must

@@ -155,7 +155,7 @@ class EngineConfig:
                  speculate_ngram=3, decode_kernel="auto",
                  kv_cache_dtype=None, journal=None, access_log=None,
                  slo=None, tp_degree=1, devices=None,
-                 tp_numerics="exact"):
+                 tp_numerics="exact", device_memory_budget=None):
         if max_batch_slots < 1:
             raise ValueError("max_batch_slots must be >= 1")
         if page_size < 1 or max_model_len < 2:
@@ -377,6 +377,22 @@ class EngineConfig:
                 f"{tp_numerics!r}"
             )
         self.tp_numerics = tp_numerics
+        # per-chip memory budget gate (paddle_tpu.analysis level 3,
+        # docs/analysis.md): when set, the engine AOT-lowers its whole
+        # program family at build and compares each program's predicted
+        # per-chip peak (``compiled.memory_analysis()``) against this
+        # byte budget — refusing the config with an AnalysisError
+        # (``analysis_check="warn"`` degrades to a warning) BEFORE the
+        # KV pool or any step buffer is allocated on a device. None
+        # disables the gate.
+        if device_memory_budget is not None:
+            device_memory_budget = int(device_memory_budget)
+            if device_memory_budget < 1:
+                raise ValueError(
+                    f"device_memory_budget must be >= 1 byte or None, "
+                    f"got {device_memory_budget}"
+                )
+        self.device_memory_budget = device_memory_budget
         self.seed = int(seed)
 
 
@@ -426,21 +442,19 @@ class Engine:
         dtype = getattr(self.adapter, "dtype", None)
         if dtype is None:
             dtype = self.adapter.weights["embed"].dtype
-        # under TP the pool allocates DIRECTLY on the mesh (pages
-        # sharded on the kv-head dim when GQA allows): a pool sized to
-        # N chips' combined KV budget must never transiently
-        # materialize whole on one chip — that transient IS the
-        # single-chip RESOURCE_EXHAUSTED ceiling this feature removes
-        self.pool = KVPool(
+        self._pool_dtype = dtype
+        # shape-only pool twin (zero device allocation): the program
+        # family is traced, lowered, and memory-gated against THIS, so
+        # a config whose predicted per-chip peak exceeds
+        # EngineConfig(device_memory_budget=) is refused before the
+        # real pool ever allocates a byte — the level-3 strengthening
+        # of the pool's shard-direct allocation discipline
+        self._pool_abstract = KVPool.abstract(
             self.adapter.num_layers, self.adapter.num_kv_heads,
             cfg.num_blocks, cfg.page_size, self.adapter.head_dim, dtype,
             quant_dtype=cfg.kv_cache_dtype,
             sharding=(
                 self.tp.pool_sharding if self.tp is not None else None
-            ),
-            shard_degree=(
-                self.tp.tp_degree
-                if self.tp is not None and self.tp.kv_sharded else 1
             ),
         )
         # decode-kernel selection lives on the adapter (the traced
@@ -513,16 +527,6 @@ class Engine:
             )
         # exported as the paddle_tpu_serving_tp_degree gauge
         self.metrics.tp_degree = cfg.tp_degree
-        self.block_manager = BlockManager(cfg.num_blocks, cfg.page_size)
-        self.prefix_cache = None
-        if cfg.enable_prefix_cache:
-            from .prefix_cache import PrefixCache
-
-            self.prefix_cache = PrefixCache(
-                self.block_manager,
-                capacity_blocks=cfg.prefix_cache_blocks,
-                metrics=self.metrics,
-            )
         self.waiting: collections.deque = collections.deque()
         self.slots: list = [None] * cfg.max_batch_slots
         # outputs for requests aborted between steps: emitted by the
@@ -541,7 +545,51 @@ class Engine:
             max_attempts=None, deadline=float("inf"),
             base_delay=0.001, max_delay=0.05, jitter=0.1, seed=cfg.seed,
         )
+        # programs FIRST, against the abstract pool twin (a compile
+        # cache warms the whole family here too) — so the memory gate
+        # below can refuse a predicted-OOM config while zero pool
+        # buffers exist on any device
         self._build_steps()
+        if cfg.device_memory_budget is not None:
+            self._enforce_memory_budget()
+        # under TP the pool allocates DIRECTLY on the mesh (pages
+        # sharded on the kv-head dim when GQA allows): a pool sized to
+        # N chips' combined KV budget must never transiently
+        # materialize whole on one chip — that transient IS the
+        # single-chip RESOURCE_EXHAUSTED ceiling this feature removes
+        self.pool = KVPool(
+            self.adapter.num_layers, self.adapter.num_kv_heads,
+            cfg.num_blocks, cfg.page_size, self.adapter.head_dim, dtype,
+            quant_dtype=cfg.kv_cache_dtype,
+            sharding=(
+                self.tp.pool_sharding if self.tp is not None else None
+            ),
+            shard_degree=(
+                self.tp.tp_degree
+                if self.tp is not None and self.tp.kv_sharded else 1
+            ),
+        )
+        self.block_manager = BlockManager(cfg.num_blocks, cfg.page_size)
+        self.prefix_cache = None
+        if cfg.enable_prefix_cache:
+            from .prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(
+                self.block_manager,
+                capacity_blocks=cfg.prefix_cache_blocks,
+                metrics=self.metrics,
+            )
+        if cfg.analysis_check is not None:
+            # the consolidated gate (L1 jaxpr checks over every enabled
+            # program family + the L3 compiled checks when summaries
+            # are already in hand — a cache-warmed family, or any
+            # engine under the memory gate; lazy engines keep their
+            # L1-only build cost)
+            self.check_programs(
+                cfg.analysis_check,
+                compiled=bool(self._aot)
+                or cfg.device_memory_budget is not None,
+            )
         # durable request journal: replayed AFTER the programs exist
         # (a compile cache has already warmed every prefill bucket by
         # now, so recovery re-prefills are zero-trace) and BEFORE any
@@ -692,7 +740,12 @@ class Engine:
         # probe. In shardings ride on the committed input arrays (lazy
         # path) / the sharding-attached abstract args (AOT path).
         if self.tp is not None:
-            kp_sh, vp_sh = self.tp.pool_out_shardings(self.pool)
+            # out shardings come from the abstract pool twin (leaves
+            # carry the same NamedSharding the real pool allocates
+            # under), so the jits exist before any pool buffer does
+            kp_sh, vp_sh = self.tp.pool_out_shardings(
+                self._pool_abstract
+            )
             rep = self.tp.replicated
             osh = {
                 "prefill": (rep, kp_sh, vp_sh),
@@ -704,25 +757,48 @@ class Engine:
             jkw = lambda kind: {"out_shardings": osh[kind]}
         else:
             jkw = lambda kind: {}
+        # the raw bodies and the exact jit options per program kind —
+        # shared by the launch jits below, the L1 analysis checks, and
+        # the isolated L3 lowering path (_lower_isolated), so all three
+        # always describe the SAME program
+        self._step_fns = {
+            "prefill": prefill_fn,
+            "decode": decode_fn,
+            "prefill_ext": prefill_ext_fn,
+            "cow": cow_fn,
+            "verify": verify_fn,
+        }
+        self._jit_specs = {
+            "prefill": dict(
+                donate_argnums=donate, static_argnums=(11,),
+                **jkw("prefill"),
+            ),
+            "decode": dict(
+                donate_argnums=donate, static_argnums=(12,),
+                **jkw("decode"),
+            ),
+            "prefill_ext": dict(
+                donate_argnums=donate, static_argnums=(12,),
+                **jkw("prefill_ext"),
+            ),
+            "cow": dict(
+                donate_argnums=(0, 1) if self._pool_donated else (),
+                **jkw("cow"),
+            ),
+            "verify": dict(donate_argnums=donate, **jkw("verify")),
+        }
         self._prefill_jit = jax.jit(
-            prefill_fn, donate_argnums=donate, static_argnums=(11,),
-            **jkw("prefill"),
+            prefill_fn, **self._jit_specs["prefill"]
         )
         self._decode_jit = jax.jit(
-            decode_fn, donate_argnums=donate, static_argnums=(12,),
-            **jkw("decode"),
+            decode_fn, **self._jit_specs["decode"]
         )
         self._prefill_ext_jit = jax.jit(
-            prefill_ext_fn, donate_argnums=donate, static_argnums=(12,),
-            **jkw("prefill_ext"),
+            prefill_ext_fn, **self._jit_specs["prefill_ext"]
         )
-        self._cow_jit = jax.jit(
-            cow_fn,
-            donate_argnums=(0, 1) if self._pool_donated else (),
-            **jkw("cow"),
-        )
+        self._cow_jit = jax.jit(cow_fn, **self._jit_specs["cow"])
         self._verify_jit = jax.jit(
-            verify_fn, donate_argnums=donate, **jkw("verify")
+            verify_fn, **self._jit_specs["verify"]
         )
         cfg = self.config
         self._chunking = cfg.prefill_chunk_tokens is not None
@@ -762,17 +838,38 @@ class Engine:
         self._aot = {}
         self._manifest = None
         self._warming = False
+        # L3 compiled-analysis summaries (collective census + memory)
+        # per program tag, however obtained: read back from a
+        # compile-cache artifact's metadata (warm restart — zero
+        # re-analysis), extracted once at store time (cold cache), or
+        # an isolated AOT lowering (lazy engine under the memory gate /
+        # an explicit check_programs() call)
+        self._program_analysis: dict = {}
+        from ..compilecache import code_fingerprint
+
+        # the adapter's code identity: the engine's programs close over
+        # adapter.prefill/decode, whose bytecode the abstract weight
+        # tree cannot see — without this an edited model would hit the
+        # pre-edit executable. Shallow like every bytecode fingerprint
+        # (docs/compilecache.md): callees of these methods are not
+        # covered (framework-internal callees are pinned by the env
+        # fingerprint's framework version).
+        self._adapter_code_fp = "|".join((
+            type(self.adapter).__qualname__,
+            code_fingerprint(getattr(self.adapter, "prefill", None))
+            or "?",
+            code_fingerprint(getattr(self.adapter, "decode", None))
+            or "?",
+            code_fingerprint(getattr(self.adapter, "prefill_ext", None))
+            or "?",
+            code_fingerprint(getattr(self.adapter, "verify", None))
+            or "?",
+        ))
         if self.config.compile_cache is not None:
             from .. import compilecache as _cc_mod
 
             self._cc = _cc_mod.resolve(self.config.compile_cache)
             self._warm_from_cache()
-        if self.config.analysis_check is not None:
-            self.check_decode(self.config.analysis_check)
-            if self._use_ext:
-                self.check_prefill(self.config.analysis_check)
-            if self._speculating:
-                self.check_verify(self.config.analysis_check)
 
     # -- persistent compile cache (paddle_tpu.compilecache) ------------------
     def _abstract_args(self, kind, bucket=None):
@@ -790,12 +887,13 @@ class Engine:
             # placements the lazy path's committed arrays carry, so the
             # cached executable IS the program a cold launch compiles
             w = self.tp.abstract(self._launch_weights())
-            kp = self.tp.abstract(self.pool.k)
-            vp = self.tp.abstract(self.pool.v)
         else:
             w = abstractify(self._launch_weights())
-            kp = abstractify(self.pool.k)
-            vp = abstractify(self.pool.v)
+        # the abstract pool twin already carries the pool's exact
+        # layout (and placement under TP) and exists before the real
+        # pool does — the memory gate lowers from it pre-allocation
+        kp = self._pool_abstract.k
+        vp = self._pool_abstract.v
         key = sds(self._base_key.shape, self._base_key.dtype)
         if kind == "prefill":
             return (
@@ -832,19 +930,12 @@ class Engine:
             sds((n,), jnp.float32), sds((n,), jnp.bool_), key,
         )
 
-    def _ensure_program(self, kind, bucket=None, any_sample=False):
-        """Load-or-compile one serving program under the compile cache.
-        A disk hit installs the deserialized executable (recorded as an
-        ``aot-hit`` event — zero traces, the compile probes stay
-        still); a miss lowers + compiles the SAME jitted function once
-        (probes fire normally), serializes it to the store, and appends
-        the program to the warmup manifest so the next engine life
-        replays it from disk."""
-        any_sample = bool(any_sample)
-        tag = (kind, bucket, any_sample)
-        exe = self._aot.get(tag)
-        if exe is not None:
-            return exe
+    def _program_meta(self, kind, bucket=None, any_sample=False):
+        """``(name, signature, store_key)`` — one program's identity
+        under the compile cache (``store_key`` is None without one).
+        Factored out of :meth:`_ensure_program` so the L3 summary path
+        can address an artifact's metadata sidecar without loading the
+        executable."""
         from .. import compilecache as _cc_mod
 
         aargs = self._abstract_args(kind, bucket)
@@ -871,13 +962,56 @@ class Engine:
             if self.tp is not None else ""
         )
         sig = (
-            f"{kind}:bucket={bucket}:any_sample={any_sample}:"
+            f"{kind}:bucket={bucket}:any_sample={bool(any_sample)}:"
             f"dk={self._decode_kernel}:{tp_sig}"
             f"code={self._adapter_code_fp}:"
             + _cc_mod.signature_str(aargs)
         )
-        key = self._cc.key(name, sig)
-        exe = self._cc.load_executable(key, name=name, signature=sig)
+        key = self._cc.key(name, sig) if self._cc is not None else None
+        return name, sig, key
+
+    def _record_summary(self, kind, bucket, any_sample, summary):
+        """Memoize one program's L3 summary and export its predicted
+        per-chip peak (``paddle_tpu_serving_program_bytes`` gauge via
+        the metrics view, ``health()``'s predicted-peak field)."""
+        if summary is None:
+            return
+        self._program_analysis[
+            (kind, bucket, bool(any_sample))
+        ] = summary
+        mem = summary.get("memory")
+        if mem:
+            label = kind if bucket is None else f"{kind}[{bucket}]"
+            if any_sample:
+                label += "+sample"
+            self.metrics.program_bytes[label] = int(mem["peak"])
+
+    def _ensure_program(self, kind, bucket=None, any_sample=False):
+        """Load-or-compile one serving program under the compile cache.
+        A disk hit installs the deserialized executable (recorded as an
+        ``aot-hit`` event — zero traces, the compile probes stay
+        still) and reads the L3 analysis summary from the artifact's
+        metadata sidecar (zero re-analysis); a miss lowers + compiles
+        the SAME jitted function once (probes fire normally), extracts
+        the summary, serializes both to the store, and appends the
+        program to the warmup manifest so the next engine life replays
+        everything from disk."""
+        any_sample = bool(any_sample)
+        tag = (kind, bucket, any_sample)
+        exe = self._aot.get(tag)
+        if exe is not None:
+            return exe
+        name, sig, key = self._program_meta(kind, bucket, any_sample)
+        aargs = self._abstract_args(kind, bucket)
+        summary = None
+        got = self._cc.load_executable_bundle(
+            key, name=name, signature=sig
+        )
+        if got is not None:
+            exe, meta, _ = got
+            summary = meta.get("analysis")
+        else:
+            exe = None
         if exe is None:
             jitted = {
                 "prefill": self._prefill_jit,
@@ -903,12 +1037,35 @@ class Engine:
                     exe = jitted.lower(*aargs).compile()
                 else:
                     exe = jitted.lower(*aargs, any_sample).compile()
-            self._cc.store_executable(key, exe, name=name, signature=sig)
+            try:
+                from ..analysis.compiled import program_summary
+
+                summary = program_summary(exe)
+            except Exception:
+                # analysis: allow(broad-except) the L3 summary is a
+                # best-effort sidecar: a backend that cannot render it
+                # must never block the compile it describes
+                summary = None
+            self._cc.store_executable(
+                key, exe, name=name, signature=sig,
+                extra_meta=(
+                    {"analysis": summary} if summary is not None
+                    else None
+                ),
+            )
         self._aot[tag] = exe
+        self._record_summary(kind, bucket, any_sample, summary)
         if self._manifest is not None:
+            extra = {}
+            mem = (summary or {}).get("memory")
+            if mem:
+                # predicted per-chip peak rides the manifest entry, so
+                # an operator can audit a service's byte budget from
+                # the manifest alone (docs/compilecache.md)
+                extra["memory"] = int(mem["peak"])
             self._manifest.add(
                 name, sig, key, kind=kind, bucket=bucket,
-                any_sample=any_sample,
+                any_sample=any_sample, **extra,
             )
             # warmup batches one save after its replay loop; only a
             # program first traced MID-SERVING flushes immediately
@@ -937,32 +1094,17 @@ class Engine:
         cfg = self.config
         import hashlib
 
-        from ..compilecache import (
-            abstractify, code_fingerprint, signature_str,
-        )
+        from ..compilecache import abstractify, signature_str
 
-        # the adapter's code identity: the engine's programs close over
-        # adapter.prefill/decode, whose bytecode the abstract weight
-        # tree cannot see — without this an edited model would hit the
-        # pre-edit executable. Shallow like every bytecode fingerprint
-        # (docs/compilecache.md): callees of these methods are not
-        # covered (framework-internal callees are pinned by the env
-        # fingerprint's framework version).
-        self._adapter_code_fp = "|".join((
-            type(self.adapter).__qualname__,
-            code_fingerprint(getattr(self.adapter, "prefill", None))
-            or "?",
-            code_fingerprint(getattr(self.adapter, "decode", None))
-            or "?",
-            code_fingerprint(getattr(self.adapter, "prefill_ext", None))
-            or "?",
-            code_fingerprint(getattr(self.adapter, "verify", None))
-            or "?",
-        ))
+        # the abstract pool twin stands in for pool.k: signature_str
+        # covers treedef + shape/dtype only, so the service key string
+        # is byte-identical to one computed from the real pool — every
+        # pre-existing manifest stays live (the adapter code identity
+        # is computed in _build_steps, before any cache work)
         svc = (
             signature_str((
                 abstractify(self._launch_weights()),
-                abstractify(self.pool.k),
+                abstractify(self._pool_abstract.k),
             ))
             + f"|slots={cfg.max_batch_slots}|mml={cfg.max_model_len}"
             + f"|page={cfg.page_size}|blocks={cfg.num_blocks}"
@@ -1080,19 +1222,221 @@ class Engine:
             expired=len(entries) - len(live),
         )
 
-    def check_decode(self, mode="error"):
-        """Statically analyze the decode step (``paddle_tpu.analysis``)
-        over representative inputs and assert it is free of host-sync
-        and retrace findings — the serving-loop invariant behind the
-        single-compile guarantee, checked WITHOUT executing anything.
-        Strengthens the compile-count probe: the probe detects a
-        retrace after it happened, this gate rejects the hazard before
-        warmup. Returns the full analysis Report.
+    # -- static analysis gates (paddle_tpu.analysis L1 + L3) -----------------
+    def check_programs(self, mode="error", compiled=True):
+        """THE analysis gate over this engine's whole program family.
+
+        Level 1 (jaxpr): the decode step, the continuation prefill +
+        COW copy (when enabled), and the speculative verify step (when
+        enabled) are traced — never executed — and held to zero
+        host-sync / retrace findings, exactly as the per-program
+        ``check_decode``/``check_prefill``/``check_verify`` delegates
+        always did. Level 3 (compiled, ``compiled=True``): every
+        program in the family is AOT-lowered and its optimized HLO +
+        memory analysis run through the collective census and the
+        per-chip memory budget gate (``analysis.check_compiled``
+        rules); findings are enforced per ``mode`` via
+        ``analysis.enforce``.
+
+        ``EngineConfig(analysis_check=)`` runs this at build (L3
+        included when the family is already compiled — a cache-warmed
+        engine — or the memory gate armed it; lazy engines keep their
+        L1-only build cost). Returns the merged analysis Report.
 
         ``mode``: "error" raises ``analysis.AnalysisError`` on a
-        violation (and on an analyzer-pass failure); "warn" degrades
+        blocking finding (and on an analyzer failure); "warn" degrades
         everything to warnings — analysis never takes down serving.
         """
+        from .. import analysis
+
+        if mode not in ("warn", "error"):
+            raise ValueError(
+                f'check_programs mode must be "warn" or "error", got '
+                f"{mode!r}"
+            )
+        report = analysis.Report()
+        report.extend(self._check_decode(mode).findings)
+        if self._use_ext:
+            report.extend(self._check_prefill(mode).findings)
+        if self._speculating:
+            report.extend(self._check_verify(mode).findings)
+        if compiled:
+            r3 = self.check_compiled_programs()
+            analysis.enforce(
+                r3, mode, what="serving compiled program family"
+            )
+            report.extend(r3.findings)
+        return report
+
+    def check_decode(self, mode="error"):
+        """Thin delegate: the decode slice of :meth:`check_programs`
+        (level 1 only), kept for callers that gate one program."""
+        return self._check_decode(mode)
+
+    def check_prefill(self, mode="error"):
+        """Thin delegate: the continuation-prefill / COW slice of
+        :meth:`check_programs` (level 1 only)."""
+        return self._check_prefill(mode)
+
+    def check_verify(self, mode="error"):
+        """Thin delegate: the speculative-verify slice of
+        :meth:`check_programs` (level 1 only)."""
+        return self._check_verify(mode)
+
+    def _program_tags(self):
+        """Every ``(kind, bucket, any_sample)`` in this engine's
+        baseline program family — the set ``_warm_from_cache`` warms
+        and the L3 checks census."""
+        cfg = self.config
+        tags = [("decode", None, False)]
+        tags += [("prefill", b, False) for b in cfg.prefill_buckets]
+        if self._use_ext:
+            tags += [
+                ("prefill_ext", b, False) for b in cfg.prefill_buckets
+            ]
+            if cfg.enable_prefix_cache:
+                tags.append(("cow", None, False))
+        if self._speculating:
+            tags.append(("verify", None, False))
+        return tags
+
+    def _lower_isolated(self, kind, bucket=None, any_sample=False):
+        """AOT-compile one program for analysis WITHOUT touching the
+        launch jits' trace caches or the compile telemetry: a fresh
+        lambda owns its own pjit cache entry, so the real first launch
+        still traces (and counts) exactly as before; the traced-body
+        probes this trace fires are snapshot-restored and the
+        compile/retrace event log is masked — the L3 counterpart of
+        the L1 harness's isolation discipline."""
+        fn = self._step_fns[kind]
+        aargs = self._abstract_args(kind, bucket)
+        m = self.metrics
+        saved = (m.prefill_compiles, m.decode_compiles,
+                 m.prefill_ext_compiles, m.cow_compiles,
+                 m.verify_compiles)
+        self._pin_adapter()
+        try:
+            with jit_events.suppress():
+                fresh = jax.jit(
+                    lambda *a: fn(*a), **self._jit_specs[kind]
+                )
+                if kind in ("cow", "verify"):
+                    return fresh.lower(*aargs).compile()
+                return fresh.lower(*aargs, bool(any_sample)).compile()
+        finally:
+            (m.prefill_compiles, m.decode_compiles,
+             m.prefill_ext_compiles, m.cow_compiles,
+             m.verify_compiles) = saved
+
+    def _program_summary(self, kind, bucket=None, any_sample=False):
+        """One program's L3 summary (collective census + per-chip
+        memory), cheapest source first: the in-process memo, the
+        compile-cache artifact's metadata sidecar (a warm restart
+        re-evaluates rules with ZERO re-analysis), the executable
+        ``_ensure_program`` holds, or — lazy engines only — one
+        isolated AOT lowering."""
+        from ..analysis.compiled import program_summary
+
+        tag = (kind, bucket, bool(any_sample))
+        s = self._program_analysis.get(tag)
+        if s is not None:
+            return s
+        if self._cc is not None:
+            # load-or-compile through the cache: both paths memoize
+            # the summary (sidecar read or extract-at-store)
+            exe = self._ensure_program(kind, bucket, any_sample)
+            s = self._program_analysis.get(tag)
+            if s is not None:
+                return s
+            # artifact predates the analysis sidecar: summarize the
+            # live executable once (no re-store; the next cold compile
+            # writes the sidecar)
+        else:
+            exe = self._lower_isolated(kind, bucket, any_sample)
+        s = program_summary(exe)
+        self._record_summary(kind, bucket, any_sample, s)
+        return s
+
+    def check_compiled_programs(self, passes=None):
+        """Level-3 analysis over the whole program family: run the
+        compiled-program rule set (collective census, per-chip memory
+        budget — ``analysis.compiled.COMPILED_PASSES``) over every
+        program's summary and return the collected Report. Pure
+        collection — callers (:meth:`check_programs`, the build-time
+        memory gate) enforce; a crashing pass or an unsummarizable
+        program degrades to a warned ``pass-crash`` finding, never an
+        exception (the ``analysis.compiled`` fault-site contract)."""
+        from .. import analysis
+        from ..analysis.compiled import summary_findings
+
+        cfg = self.config
+        report = analysis.Report()
+        for kind, bucket, any_sample in self._program_tags():
+            label = (
+                f"serving.{kind}" if bucket is None
+                else f"serving.{kind}[{bucket}]"
+            )
+            try:
+                summary = self._program_summary(
+                    kind, bucket, any_sample
+                )
+            except Exception as e:
+                # analysis: allow(broad-except) an analyzer compile
+                # failure degrades like a crashing pass — L3 must
+                # never take down an engine build
+                report.add(analysis.Finding(
+                    rule="pass-crash",
+                    severity=analysis.Severity.WARNING,
+                    message=(
+                        f"compiled analysis of {label} crashed: {e!r}"
+                    ),
+                    root=label,
+                ))
+                continue
+            report.extend(summary_findings(
+                summary,
+                program=label,
+                tp_numerics=(
+                    cfg.tp_numerics if self.tp is not None else None
+                ),
+                tp_degree=cfg.tp_degree,
+                device_memory_budget=cfg.device_memory_budget,
+                mode="collect",
+                passes=passes,
+            ))
+        return report
+
+    def _enforce_memory_budget(self):
+        """The build-time memory gate: census the family's predicted
+        per-chip peaks against ``EngineConfig(device_memory_budget=)``
+        and refuse (``analysis_check=None``/"error") or warn ("warn")
+        BEFORE the KV pool exists — a config that would die with
+        RESOURCE_EXHAUSTED never allocates its pool."""
+        from .. import analysis
+
+        mode = self.config.analysis_check or "error"
+        report = self.check_compiled_programs(
+            passes=("memory-budget",)
+        )
+        if self._manifest is not None:
+            # the gate may have appended memory= extras after warmup's
+            # batched save — persist them for the manifest audit trail
+            self._save_manifest()
+        analysis.enforce(
+            report, mode,
+            what=(
+                "serving program family under EngineConfig("
+                f"device_memory_budget={self.config.device_memory_budget})"
+            ),
+        )
+        return report
+
+    def _check_decode(self, mode="error"):
+        """The decode slice of :meth:`check_programs` (level 1): trace
+        the decode step over representative inputs and assert it is
+        free of host-sync and retrace findings — the serving-loop
+        invariant behind the single-compile guarantee, checked WITHOUT
+        executing anything. Returns the full analysis Report."""
         from .. import analysis
 
         if mode not in ("warn", "error"):
@@ -1132,7 +1476,7 @@ class Engine:
                     any_sample,
                     static_argnums=(12,),
                     donate_argnums=(1, 2) if self._pool_donated else (),
-                    mode=mode,
+                    mode=mode, root="serving.decode",
                 )
                 for f in variant.findings:
                     key = (f.rule, f.file, f.line, f.message)
@@ -1157,14 +1501,13 @@ class Engine:
             warnings.warn(msg, stacklevel=2)
         return report
 
-    def check_prefill(self, mode="error"):
-        """``check_decode``'s counterpart for the prefix-cache /
-        chunked-prefill program family: statically analyze the
-        continuation prefill (both static sampling variants) and the
-        COW block copy, asserting zero host-sync and retrace findings —
-        a chunk launch sits on the same latency-critical path as the
-        decode step. Trace-only; compile probes are restored after.
-        Returns the analysis Report."""
+    def _check_prefill(self, mode="error"):
+        """The prefix-cache / chunked-prefill slice of
+        :meth:`check_programs` (level 1): the continuation prefill
+        (both static sampling variants) and the COW block copy, held to
+        zero host-sync and retrace findings — a chunk launch sits on
+        the same latency-critical path as the decode step. Trace-only;
+        compile probes are restored after."""
         from .. import analysis
 
         if mode not in ("warn", "error"):
@@ -1199,14 +1542,14 @@ class Engine:
                     np.float32(1.0), np.int32(0), np.float32(1.0),
                     np.bool_(any_sample), self._base_key, any_sample,
                     static_argnums=(12,), donate_argnums=donate,
-                    mode=mode,
+                    mode=mode, root="serving.prefill_ext",
                 ))
             if cfg.enable_prefix_cache:
                 merge(analysis.check(
                     self._cow_fn, self.pool.k, self.pool.v,
                     np.int32(0), np.int32(1),
                     donate_argnums=(0, 1) if self._pool_donated else (),
-                    mode=mode,
+                    mode=mode, root="serving.cow",
                 ))
         finally:
             (m.prefill_compiles, m.decode_compiles,
@@ -1227,14 +1570,12 @@ class Engine:
             warnings.warn(msg, stacklevel=2)
         return report
 
-    def check_verify(self, mode="error"):
-        """``check_decode``'s counterpart for the speculative VERIFY
-        program: statically analyze the draft-window scoring step and
-        assert zero host-sync and retrace findings — a verify launch
-        replaces the decode launch on the latency-critical greedy path,
-        so it is held to the same single-compile invariant. Trace-only;
-        compile probes are restored after. Returns the analysis
-        Report."""
+    def _check_verify(self, mode="error"):
+        """The speculative-VERIFY slice of :meth:`check_programs`
+        (level 1): the draft-window scoring step, held to zero
+        host-sync and retrace findings — a verify launch replaces the
+        decode launch on the latency-critical greedy path. Trace-only;
+        compile probes are restored after."""
         from .. import analysis
 
         if mode not in ("warn", "error"):
@@ -1262,7 +1603,7 @@ class Engine:
                 np.zeros((n, cfg.pages_per_seq), np.int32),
                 np.zeros(n, bool),
                 donate_argnums=(1, 2) if self._pool_donated else (),
-                mode=mode,
+                mode=mode, root="serving.verify",
             )
         finally:
             (m.prefill_compiles, m.decode_compiles,
@@ -1703,6 +2044,16 @@ class Engine:
             "kv_bytes_per_token": self.pool.bytes_per_token(),
             "kv_bytes_per_token_per_chip": (
                 self.pool.bytes_per_token_per_chip()
+            ),
+            # the L3 memory gate's view: the configured per-chip byte
+            # budget (None = gate off) and the largest predicted
+            # per-chip peak across the analyzed program family (None
+            # until any program has been summarized — lazy engines
+            # without the gate never pay for the prediction)
+            "device_memory_budget": cfg.device_memory_budget,
+            "predicted_peak_bytes_per_chip": (
+                max(self.metrics.program_bytes.values())
+                if self.metrics.program_bytes else None
             ),
             "kv_utilization": util,
             "kv_active_utilization": util_active,

@@ -177,6 +177,54 @@ class KVPool:
         self._per_chip_nbytes = None
         self.per_chip_nbytes()
 
+    @staticmethod
+    def abstract(num_layers, num_kv_heads, num_blocks, block_size,
+                 head_dim, dtype="float32", quant_dtype=None,
+                 sharding=None):
+        """Shape-only twin of a :class:`KVPool`: ``.k``/``.v`` trees of
+        ``jax.ShapeDtypeStruct`` with the exact per-layer layout (and,
+        when given, the tensor-parallel ``sharding`` attached) that
+        ``__init__`` would materialize — but ZERO device allocation.
+        The engine traces, lowers, and memory-gates its whole program
+        family against this twin BEFORE the real pool exists, so a
+        config predicted to exceed ``device_memory_budget`` is refused
+        without a single pool buffer ever being allocated."""
+        import jax
+
+        if quant_dtype not in (None, "int8"):
+            raise ValueError(
+                f'KVPool quant_dtype must be None or "int8", got '
+                f"{quant_dtype!r}"
+            )
+        shape = (num_kv_heads, num_blocks, block_size, head_dim)
+
+        def sds(shp, dt):
+            if sharding is None:
+                return jax.ShapeDtypeStruct(shp, jnp.dtype(dt))
+            return jax.ShapeDtypeStruct(
+                shp, jnp.dtype(dt), sharding=sharding
+            )
+
+        if quant_dtype == "int8":
+            sshape = (num_kv_heads, num_blocks, block_size)
+
+            def entry():
+                return (sds(shape, jnp.int8), sds(sshape, jnp.float32))
+        else:
+            def entry():
+                return sds(shape, dtype)
+
+        class _Abstract:
+            pass
+
+        out = _Abstract()
+        out.k = tuple(entry() for _ in range(num_layers))
+        out.v = tuple(entry() for _ in range(num_layers))
+        out.num_layers = int(num_layers)
+        out.num_blocks = int(num_blocks)
+        out.block_size = int(block_size)
+        return out
+
     def _layer_leaves(self, entry):
         """The validated leaves of one per-layer entry: (pages,) for a
         float pool, (pages, scales) for a quantized one."""

@@ -99,6 +99,16 @@ def _register_view(metrics, engine_id):
             fams.append(MetricFamily(
                 f"paddle_tpu_serving_{key}{suffix}", kind,
             ).add(value, label))
+        if m.program_bytes:
+            # predicted per-chip peak per compiled serving program
+            # (the L3 memory-budget gate's source of truth), one
+            # sample per program label
+            fam = MetricFamily(
+                "paddle_tpu_serving_program_bytes", "gauge",
+            )
+            for prog, nbytes in sorted(m.program_bytes.items()):
+                fam.add(nbytes, {**label, "program": prog})
+            fams.append(fam)
         hist = m.spec_accept_hist()
         if hist:
             # per-step accepted-draft-length histogram (Prometheus
@@ -183,6 +193,12 @@ class EngineMetrics:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self._spec_accept_counts: dict = {}
+        # L3 compiled analysis: predicted per-chip peak bytes per
+        # serving program ({"decode": ..., "prefill[16]": ...}),
+        # populated as programs are summarized (compile-cache sidecar
+        # or AOT lowering) — exported per-program as the
+        # paddle_tpu_serving_program_bytes{program=} gauge
+        self.program_bytes: dict = {}
         # gauges (updated by the engine each step)
         self.queue_depth = 0
         self.num_running = 0

@@ -312,6 +312,8 @@ class Server(ThreadedHTTPHost):
         ``server_draining``), wait for in-flight requests to finish.
         Returns True when everything drained inside ``timeout``
         (default ``drain_timeout_s``)."""
+        # GIL-atomic one-way bool flip; racing writers all write True
+        # analysis: allow(unlocked-shared-mutation) benign idempotent flag
         self._draining = True
         self.metrics.draining = True
         deadline = time.monotonic() + (
@@ -342,6 +344,8 @@ class Server(ThreadedHTTPHost):
     def close(self):
         if self._closed:
             return
+        # one-way bool flip; a racing duplicate close is idempotent
+        # analysis: allow(unlocked-shared-mutation) benign idempotent flag
         self._closed = True
         with self._progress:
             self._progress.notify_all()

@@ -62,7 +62,8 @@ class Cifar10(Dataset):
         if backend == "synthetic" or (
             not os.path.exists(data_file) and allow_synthetic
         ):
-            n = synthetic_size or (1024 if mode == "train" else 256)
+            n = (synthetic_size if synthetic_size is not None
+                 else (1024 if mode == "train" else 256))
             self._syn = _SyntheticImages(
                 n, (32, 32, 3), self.num_classes, transform,
                 seed=0 if mode == "train" else 1,
@@ -117,7 +118,8 @@ class MNIST(Dataset):
             or image_path is None
             or not os.path.exists(image_path)
         ) and allow_synthetic:
-            n = synthetic_size or (1024 if mode == "train" else 256)
+            n = (synthetic_size if synthetic_size is not None
+                 else (1024 if mode == "train" else 256))
             self._syn = _SyntheticImages(
                 n, (28, 28), self.num_classes, transform,
                 seed=2 if mode == "train" else 3,

@@ -1,11 +1,15 @@
 """paddle_tpu.analysis: jaxpr analyzer rules (one known-bad fixture per
 rule asserting the exact rule id + file:line provenance), AST
-trace-safety lint, choke points (to_static(check=), Engine.check_decode,
-the CI self-lint gate), and the analysis.pass fault site.
+trace-safety lint (including the concurrency rules), the compiled-
+program (L3) census + memory-budget passes, choke points
+(to_static(check=), Engine.check_programs and its delegates, the
+engine memory gate, the CI self-lint gate), the CLI exit-code
+contract, and the analysis.pass / analysis.compiled fault sites.
 
-Everything here is trace-only (nothing compiles or executes on device)
-except the two tiny to_static executions in TestChokePoints — the suite
-stays cheap inside the tier-1 budget.
+Everything here is trace-only or pure-host (synthetic summaries, AST
+fixtures) except a handful of tiny single-chip AOT compiles — the
+suite stays cheap inside the tier-1 budget; the tp=2 census
+subprocess lane is marked slow.
 """
 import inspect
 import os
@@ -19,8 +23,14 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+from device_fixture import run_with_device_count
 from paddle_tpu import analysis
 from paddle_tpu.analysis import AnalysisError, Finding, Severity
+from paddle_tpu.analysis.compiled import (
+    census_summary,
+    hlo_collectives,
+    summary_findings,
+)
 from paddle_tpu.resilience import faults
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -335,6 +345,343 @@ class TestAstLint:
         assert analysis.lint_source(src, filename="ok.py") == []
 
 
+_AST_CONC = """\
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = "idle"
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run)
+        self._thread.start()
+
+    def _run(self):
+        self._state = "running"
+
+    def stop(self):
+        with self._lock:
+            self._state = "stopped"
+
+    def poke(self):
+        self._state = "poked"
+
+    def note(self):
+        # analysis: allow(unlocked-shared-mutation) fixture: reason
+        self._state = "noted"
+
+
+class NoThreads:
+    def __init__(self):
+        self._state = "idle"
+
+    def set(self):
+        self._state = "set"
+
+
+def bad_guard(self, now):
+    since = self._hot_since or now
+    return since
+
+
+def bad_guard_dataflow(xs):
+    n = len(xs)
+    return n or 1
+
+
+def bad_guard_time():
+    t = time.monotonic()
+    return t or 1.0
+
+
+def ok_guard(self, now):
+    since = self._hot_since if self._hot_since is not None else now
+    return since
+
+
+def ok_guard_annotated(self, now):
+    # analysis: allow(falsy-zero-guard) fixture: reason goes here
+    since = self._hot_since or now
+    return since
+
+
+def ok_flag(self, fallback):
+    return self._label or fallback
+"""
+
+
+class TestConcurrencyRules:
+    """The two analysis-v2 L2 rules, one firing + one suppressed + one
+    clean fixture each (the tier-1 gate that proves each rule works)."""
+
+    def _findings(self, rule):
+        fs = analysis.lint_source(_AST_CONC, filename="conc.py")
+        return [f for f in fs if f.rule == rule]
+
+    def test_unlocked_shared_mutation_fires(self):
+        lines = {f.line for f in self._findings(
+            "unlocked-shared-mutation"
+        )}
+        # the thread-root write and the caller-thread write are both
+        # flagged; the lock-guarded write, the allow-annotated write,
+        # the pre-thread __init__ writes, and the whole thread-free
+        # twin class are not
+        assert lines == {
+            _src_line(_AST_CONC, 'self._state = "running"'),
+            _src_line(_AST_CONC, 'self._state = "poked"'),
+        }
+
+    def test_unlocked_shared_mutation_names_roots(self):
+        (f, _) = sorted(self._findings("unlocked-shared-mutation"),
+                        key=lambda f: f.line)
+        assert "_state" in f.message
+        assert "thread root" in f.message
+        assert f.severity == Severity.WARNING
+
+    def test_falsy_zero_guard_fires(self):
+        lines = {f.line for f in self._findings("falsy-zero-guard")}
+        # fires on the timestamp-named attribute, the len()-derived
+        # size, and the time.monotonic()-derived value; the `is not
+        # None` rewrite, the annotated site, and the string-valued
+        # `_label or fallback` are all clean
+        assert lines == {
+            _src_line(_AST_CONC, "since = self._hot_since or now"),
+            _src_line(_AST_CONC, "return n or 1"),
+            _src_line(_AST_CONC, "return t or 1.0"),
+        }
+
+    def test_falsy_zero_guard_suggests_rewrite(self):
+        f = min(self._findings("falsy-zero-guard"),
+                key=lambda f: f.line)
+        assert "is not None" in f.message
+        assert f.severity == Severity.WARNING
+
+
+# ---------------------------------------------------------------- level 3 --
+_HLO_FIXTURE = """\
+HloModule jit_step, entry_computation_layout={(f32[8,16]{1,0})->f32[8,32]{1,0}}
+
+ENTRY main {
+  p0 = f32[8,16]{1,0} parameter(0)
+  ag = f32[8,32]{1,0} all-gather(p0), dimensions={1}, metadata={op_name="jit(step)/gather"}
+  ar = f32[8,32]{1,0} all-reduce(ag), to_apply=add
+  ags = (f32[8,16]{1,0}, f32[8,32]{1,0}) all-gather-start(p0), dimensions={1}
+  agd = f32[8,32]{1,0} all-gather-done(ags)
+  rs = f32[4,32]{1,0} reduce-scatter(ar), dimensions={0}, to_apply=add
+  cp = f32[4,32]{1,0} collective-permute(rs), source_target_pairs={{0,1}}
+  ROOT t = f32[8,32]{1,0} add(ag, ar)
+}
+"""
+
+
+class TestHloCensus:
+    """Pure text parsing: the HLO collective census over a fixture."""
+
+    def test_occurrences_ops_and_sources(self):
+        occ = hlo_collectives(_HLO_FIXTURE)
+        ops = [o["op"] for o in occ]
+        # -start counts as the transfer, the paired -done must not
+        # double-count it; plain ops count once each
+        assert ops == [
+            "all-gather", "all-reduce", "all-gather",
+            "reduce-scatter", "collective-permute",
+        ]
+        assert occ[0]["source"] == "jit(step)/gather"
+        assert occ[1]["source"] == ""
+
+    def test_result_bytes_from_shape(self):
+        occ = hlo_collectives(_HLO_FIXTURE)
+        assert occ[0]["bytes"] == 8 * 32 * 4       # f32[8,32]
+        assert occ[3]["bytes"] == 4 * 32 * 4       # f32[4,32]
+        # tuple-typed -start results sum their elements
+        assert occ[2]["bytes"] == (8 * 16 + 8 * 32) * 4
+
+    def test_census_summary_aggregates(self):
+        census = census_summary(hlo_collectives(_HLO_FIXTURE))
+        ag = census["all-gather"]
+        assert ag["count"] == 2
+        assert ag["bytes"] == 8 * 32 * 4 + (8 * 16 + 8 * 32) * 4
+        assert ag["max_bytes"] == (8 * 16 + 8 * 32) * 4
+        assert census["all-reduce"]["count"] == 1
+        assert set(census) == {
+            "all-gather", "all-reduce", "reduce-scatter",
+            "collective-permute",
+        }
+
+    def test_collective_free_text_is_empty(self):
+        assert hlo_collectives("ENTRY main { ROOT p = f32[2]{0} parameter(0) }") == []
+
+
+def _summary(census=None, memory=None):
+    return {"census": census or {}, "memory": memory}
+
+
+class TestSummaryRules:
+    """Rule logic over synthetic program summaries — the exact path a
+    warm-restarted engine takes over summaries read back from
+    compile-cache artifact metadata (zero re-analysis)."""
+
+    _AR = {"all-reduce": {"count": 2, "bytes": 4096, "max_bytes": 2048}}
+
+    def test_unexpected_collective_under_exact(self):
+        fs = summary_findings(
+            _summary(census=dict(self._AR)), program="serving.decode",
+            tp_numerics="exact", tp_degree=2,
+        )
+        (f,) = [x for x in fs if x.rule == "unexpected-collective"]
+        assert f.severity == Severity.ERROR
+        assert 'tp_numerics="exact"' in f.message
+        assert f.root == "serving.decode"
+
+    def test_unexpected_collective_under_tp1_default(self):
+        # tp=1 with no declared contract: ANY reduction collective is
+        # unexpected (nothing should cross chips at all)
+        fs = summary_findings(
+            _summary(census=dict(self._AR)), tp_numerics=None,
+            tp_degree=1,
+        )
+        assert [x.rule for x in fs] == ["unexpected-collective"]
+        assert "tp_degree=1" in fs[0].message
+
+    def test_gathers_are_exact_safe(self):
+        # all-gather is order-preserving data movement: expected under
+        # the exact contract, never an unexpected-collective
+        fs = summary_findings(
+            _summary(census={"all-gather": {
+                "count": 4, "bytes": 1 << 16, "max_bytes": 1 << 14,
+            }}),
+            tp_numerics="exact", tp_degree=2,
+        )
+        assert not [x for x in fs if x.rule == "unexpected-collective"]
+
+    def test_fast_mode_accepts_reductions(self):
+        fs = summary_findings(
+            _summary(census=dict(self._AR)), tp_numerics="fast",
+            tp_degree=2,
+        )
+        assert not [x for x in fs if x.rule == "unexpected-collective"]
+
+    def test_resharding_copy_threshold(self):
+        big = {"all-gather": {
+            "count": 1, "bytes": 9 << 20, "max_bytes": 9 << 20,
+        }}
+        fs = summary_findings(
+            _summary(census=big), tp_numerics="fast", tp_degree=2,
+        )
+        (f,) = [x for x in fs if x.rule == "resharding-copy"]
+        assert f.severity == Severity.WARNING
+        # one byte under the threshold: clean
+        small = {"all-gather": {
+            "count": 1, "bytes": 1024, "max_bytes": (8 << 20) - 1,
+        }}
+        assert not summary_findings(
+            _summary(census=small), tp_numerics="fast", tp_degree=2,
+        )
+
+    def test_memory_budget_names_program_and_budget(self):
+        mem = {"argument": 900, "output": 300, "temp": 100,
+               "alias": 200, "generated_code": 0, "peak": 1100}
+        fs = summary_findings(
+            _summary(memory=mem), program="serving.prefill[32]",
+            device_memory_budget=1000,
+        )
+        (f,) = fs
+        assert f.rule == "memory-budget"
+        assert f.severity == Severity.ERROR
+        assert "serving.prefill[32]" in f.message
+        assert "device_memory_budget=1000" in f.message
+        assert "1100" in f.message
+        assert f.root == "serving.prefill[32]"
+
+    def test_memory_budget_quiet_under_budget_or_unarmed(self):
+        mem = {"argument": 900, "output": 300, "temp": 100,
+               "alias": 200, "generated_code": 0, "peak": 1100}
+        assert not summary_findings(
+            _summary(memory=mem), device_memory_budget=1100,
+        )
+        assert not summary_findings(_summary(memory=mem))
+        assert not summary_findings(
+            _summary(memory=None), device_memory_budget=1,
+        )
+
+    def test_passes_filter(self):
+        fs = summary_findings(
+            _summary(
+                census=dict(self._AR),
+                memory={"argument": 2, "output": 0, "temp": 0,
+                        "alias": 0, "generated_code": 0, "peak": 2},
+            ),
+            tp_numerics="exact", tp_degree=2, device_memory_budget=1,
+            passes=("memory-budget",),
+        )
+        assert [x.rule for x in fs] == ["memory-budget"]
+
+
+class TestCheckCompiled:
+    """End-to-end L3 over real (tiny, single-chip, CPU) AOT compiles."""
+
+    def test_clean_program_census_and_memory(self):
+        r = analysis.check_compiled(
+            lambda x: x * 2.0 + 1.0, jnp.ones((16, 16)),
+        )
+        assert r.census == {}          # single chip: no collectives
+        assert r.memory is not None and r.memory["peak"] > 0
+        assert len(r) == 0, r.render()
+
+    def test_accepts_lowered_and_compiled_stages(self):
+        fn = jax.jit(lambda x: x + 1.0)
+        lowered = fn.lower(jnp.ones(4))
+        assert analysis.check_compiled(lowered).memory is not None
+        assert analysis.check_compiled(
+            lowered.compile()
+        ).memory is not None
+
+    def test_memory_budget_finding_on_real_program(self):
+        r = analysis.check_compiled(
+            lambda x: x @ x, jnp.ones((64, 64)),
+            device_memory_budget=1, program="toy",
+        )
+        (f,) = r.by_rule("memory-budget")
+        assert "toy" in f.message
+        assert "device_memory_budget=1" in f.message
+
+    def test_compile_crash_isolated_per_mode(self):
+        def broken(x):
+            raise TypeError("not lowerable")
+
+        r = analysis.check_compiled(broken, jnp.ones(2))
+        assert r.by_rule("compile-crash")
+        with pytest.warns(UserWarning, match="analysis compile"):
+            analysis.check_compiled(broken, jnp.ones(2), mode="warn")
+        with pytest.raises(AnalysisError, match="analysis compile"):
+            analysis.check_compiled(broken, jnp.ones(2), mode="error")
+
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(ValueError, match="mode must be"):
+            analysis.check_compiled(
+                lambda x: x, jnp.ones(2), mode="eror"
+            )
+
+    def test_analysis_compile_does_not_warm_pjit_cache(self):
+        # the isolation discipline: analyzing a function must not seed
+        # the trace cache a later real jit launch would hit (nor
+        # consume a warm entry the launch relies on)
+        traces = []
+
+        def fn(x):
+            traces.append(1)  # traced-body probe: fires per trace
+            return x * 3.0
+
+        analysis.check_compiled(fn, jnp.ones(3))
+        assert len(traces) == 1
+        jax.jit(fn)(jnp.ones(3))
+        assert len(traces) == 2  # the real launch still traced
+
+
 # ------------------------------------------------------------ choke points --
 class TestToStaticCheck:
     def test_check_error_blocks_host_sync(self):
@@ -469,6 +816,140 @@ class TestServingDecodeCheck:
             engine._decode_fn = real
 
 
+class TestEngineProgramFamily:
+    """Engine.check_programs / check_compiled_programs: the L1+L3 gate
+    over the whole serving program family, plus the per-chip memory
+    accounting it feeds into health() and the metrics view."""
+
+    @pytest.fixture(scope="class")
+    def engine(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.serving import Engine, EngineConfig
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        # pool-dominated config (the calibration target) with a
+        # generous budget: the gate runs at build and passes
+        return Engine(model, EngineConfig(
+            max_batch_slots=2, max_model_len=32, page_size=8,
+            num_blocks=512, device_memory_budget=1 << 30,
+        ))
+
+    def test_program_bytes_per_program(self, engine):
+        pb = engine.metrics.program_bytes
+        assert "decode" in pb
+        assert any(k.startswith("prefill[") for k in pb)
+        assert all(v > 0 for v in pb.values())
+
+    def test_memory_gate_calibration(self, engine):
+        # predicted per-chip peak vs the pool actually allocated: the
+        # pool appears once as an argument and once as the donated
+        # output (CPU's memory analysis reports no aliasing), so the
+        # documented band is [pool, 2*pool + program overhead]
+        peak = max(engine.metrics.program_bytes.values())
+        pool = engine.pool.per_chip_nbytes()
+        assert pool <= peak <= 2 * pool + (4 << 20), (peak, pool)
+
+    def test_health_exposes_budget_and_peak(self, engine):
+        h = engine.health()
+        assert h["device_memory_budget"] == 1 << 30
+        assert h["predicted_peak_bytes_per_chip"] == max(
+            engine.metrics.program_bytes.values()
+        )
+
+    def test_metrics_view_exports_program_bytes(self, engine):
+        from paddle_tpu.observability import get_registry
+
+        text = get_registry().render_prometheus()
+        assert "paddle_tpu_serving_program_bytes{" in text
+        assert 'program="decode"' in text
+
+    def test_check_programs_whole_family_clean(self, engine):
+        before = (engine.metrics.prefill_compiles,
+                  engine.metrics.decode_compiles)
+        report = engine.check_programs(mode="error")
+        assert not report.by_rule("host-sync"), report.render()
+        assert not report.by_rule("unexpected-collective")
+        assert not report.by_rule("memory-budget")
+        # both the L1 traces and the L3 lowerings are isolated: the
+        # real programs' compile probes never move
+        assert (engine.metrics.prefill_compiles,
+                engine.metrics.decode_compiles) == before
+
+    def test_check_programs_rejects_bad_mode(self, engine):
+        with pytest.raises(ValueError, match="check_programs mode"):
+            engine.check_programs(mode="eror")
+
+    def test_delegates_still_work(self, engine):
+        # the old per-program entry points survive as thin delegates
+        r = engine.check_decode(mode="error")
+        assert isinstance(r, analysis.Report)
+        assert isinstance(engine.check_prefill(mode="warn"),
+                          analysis.Report)
+        # ...including their contracts: verify needs speculation
+        with pytest.raises(RuntimeError, match="speculate_tokens"):
+            engine.check_verify(mode="warn")
+
+    def test_census_empty_on_single_chip(self, engine):
+        r = engine.check_compiled_programs()
+        assert not r.findings, r.render()
+
+
+class TestEngineMemoryBudgetGate:
+    def _model(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(0)
+        return LlamaForCausalLM(LlamaConfig.tiny())
+
+    def test_oversized_config_refused_before_any_allocation(
+        self, monkeypatch
+    ):
+        from paddle_tpu.serving import Engine, EngineConfig
+        from paddle_tpu.serving import kv_cache
+
+        model = self._model()
+
+        def _no_alloc(self, *a, **kw):
+            raise AssertionError(
+                "KVPool allocated device memory for a config the "
+                "budget gate should have refused"
+            )
+
+        monkeypatch.setattr(kv_cache.KVPool, "__init__", _no_alloc)
+        # a config deliberately oversized to back a huge prefix cache
+        with pytest.raises(AnalysisError) as ei:
+            Engine(model, EngineConfig(
+                max_batch_slots=2, max_model_len=32, page_size=8,
+                num_blocks=4096, prefix_cache_blocks=4096,
+                device_memory_budget=1_000_000,
+            ))
+        fs = ei.value.report.by_rule("memory-budget")
+        assert fs, ei.value.report.render()
+        assert any("serving.decode" in f.message for f in fs)
+        assert all(
+            "device_memory_budget=1000000" in f.message for f in fs
+        )
+
+    @pytest.mark.slow  # full engine build (~2s); the refusal path stays tier-1
+    def test_warn_mode_builds_with_warning(self):
+        from paddle_tpu.serving import Engine, EngineConfig
+
+        model = self._model()
+        with pytest.warns(UserWarning, match="memory-budget"):
+            eng = Engine(model, EngineConfig(
+                max_batch_slots=2, max_model_len=32, page_size=8,
+                analysis_check="warn", device_memory_budget=100_000,
+            ))
+        assert eng.pool is not None  # warned through, still serving
+
+    def test_budget_validation(self):
+        from paddle_tpu.serving import EngineConfig
+
+        with pytest.raises(ValueError, match="device_memory_budget"):
+            EngineConfig(device_memory_budget=0)
+
+
 # ------------------------------------------------------------- fault site --
 class TestAnalysisPassFaultSite:
     def _target(self):
@@ -505,6 +986,47 @@ class TestAnalysisPassFaultSite:
         assert r.by_rule("pass-crash")
 
 
+class TestCompiledFaultSite:
+    """analysis.compiled: a crashing L3 pass degrades per mode and is
+    never fatal at engine build (docs/resilience.md catalog)."""
+
+    def test_collect_records_pass_crash(self):
+        spec = faults.FaultSpec(RuntimeError("L3 boom"), at=1)
+        with faults.inject({"analysis.compiled": spec}) as inj:
+            fs = summary_findings(
+                _summary(), program="serving.decode",
+                device_memory_budget=1,
+            )
+        assert inj.fired["analysis.compiled"] == 1
+        (f,) = [x for x in fs if x.rule == "pass-crash"]
+        assert f.severity == Severity.WARNING
+        assert f.root == "serving.decode"
+
+    def test_warn_and_error_modes(self):
+        spec = faults.FaultSpec(RuntimeError("L3 boom"), every=1)
+        with faults.inject({"analysis.compiled": spec}):
+            with pytest.warns(UserWarning, match="L3 boom"):
+                summary_findings(_summary(), mode="warn")
+            with pytest.raises(AnalysisError, match="L3 boom"):
+                summary_findings(_summary(), mode="error")
+
+    @pytest.mark.slow  # full engine build (~2s); cheap variants above stay tier-1
+    def test_engine_build_survives_l3_crash(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        from paddle_tpu.serving import Engine, EngineConfig
+
+        paddle.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny())
+        spec = faults.FaultSpec(RuntimeError("L3 boom"), every=1)
+        with faults.inject({"analysis.compiled": spec}):
+            with pytest.warns(UserWarning, match="pass-crash"):
+                eng = Engine(model, EngineConfig(
+                    max_batch_slots=2, max_model_len=32, page_size=8,
+                    device_memory_budget=1 << 30,
+                ))
+        assert eng.pool is not None  # degraded to a warning, built
+
+
 # ------------------------------------------------------------- satellites --
 class TestFoundInfDtypePinned:
     def test_default_found_inf_is_strongly_typed_bool(self):
@@ -530,7 +1052,134 @@ class TestFoundInfDtypePinned:
         assert _found_inf_operand(_Opt()) is sentinel
 
 
+# ------------------------------------------------------------------- tp=2 --
+def _tp_census_probe():
+    """Subprocess payload (2 forced host devices): the tp=2 census
+    acceptance pair — a numerics-preserving col-parallel matmul must
+    census ZERO unexpected-collectives under the exact contract, and a
+    forced partial-sum (contraction-dim sharded) matmul must census at
+    least one; the same partial-sum program is accepted when the
+    contract is declared "fast"."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu import analysis
+
+    mesh = Mesh(jax.devices()[:2], ("tp",))
+    x = jnp.ones((8, 16))
+    w = jnp.ones((16, 32))
+    repl = NamedSharding(mesh, P())
+    col = NamedSharding(mesh, P(None, "tp"))   # shard the OUTPUT dim
+    row = NamedSharding(mesh, P("tp", None))   # shard the CONTRACTION
+
+    def mm(x, w):
+        return x @ w
+
+    exact = jax.jit(
+        mm, in_shardings=(repl, col), out_shardings=repl,
+    ).lower(x, w).compile()
+    partial = jax.jit(
+        mm, in_shardings=(col, row), out_shardings=repl,
+    ).lower(x, w).compile()
+    r_exact = analysis.check_compiled(
+        exact, tp_numerics="exact", tp_degree=2)
+    r_partial = analysis.check_compiled(
+        partial, tp_numerics="exact", tp_degree=2)
+    r_fast = analysis.check_compiled(
+        partial, tp_numerics="fast", tp_degree=2)
+
+    def _n(r):
+        return len([f for f in r.findings
+                    if f.rule == "unexpected-collective"])
+
+    # ...and the real thing: the tp=2 engine's whole program family
+    # under its default exact contract censuses clean
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import Engine, EngineConfig
+
+    paddle.seed(0)
+    eng = Engine(
+        LlamaForCausalLM(LlamaConfig.tiny()),
+        EngineConfig(
+            max_batch_slots=2, max_model_len=16, page_size=4,
+            prefill_buckets=[16], tp_degree=2,
+        ),
+    )
+    r_eng = eng.check_compiled_programs()
+    return {
+        "exact_census_ops": sorted(r_exact.census),
+        "exact_unexpected": _n(r_exact),
+        "partial_census_ops": sorted(r_partial.census),
+        "partial_unexpected": _n(r_partial),
+        "fast_unexpected": _n(r_fast),
+        "engine_unexpected": len(
+            r_eng.by_rule("unexpected-collective")
+        ),
+        "engine_errors": [f.render() for f in r_eng.errors],
+        "engine_programs": sorted(eng.metrics.program_bytes),
+    }
+
+
+@pytest.mark.slow  # subprocess re-init of jax with 2 forced devices
+class TestCensusTP:
+    def test_tp2_exact_vs_forced_partial_sum(self):
+        res = run_with_device_count(2, "test_analysis:_tp_census_probe")
+        assert res["exact_unexpected"] == 0
+        assert "all-reduce" not in res["exact_census_ops"]
+        assert res["partial_unexpected"] >= 1
+        assert "all-reduce" in res["partial_census_ops"]
+        assert res["fast_unexpected"] == 0
+        # the sharded engine family upholds its exact contract
+        assert res["engine_unexpected"] == 0
+        assert res["engine_errors"] == []
+        assert "decode" in res["engine_programs"]
+
+
 # ---------------------------------------------------------------- CI gate --
+class TestCliExitCodes:
+    """The documented ``python -m paddle_tpu.analysis`` exit-code
+    contract (0 clean / 1 findings / 2 usage), exercised in-process."""
+
+    def _main(self, argv):
+        from paddle_tpu.analysis.__main__ import main
+
+        return main(argv)
+
+    def test_clean_file_exits_zero_and_says_so(self, tmp_path, capsys):
+        p = tmp_path / "ok.py"
+        p.write_text("def fine(x):\n    return x + 1\n")
+        assert self._main([str(p)]) == 0
+        # "no output" can never be confused with "did not run"
+        assert "clean (0 findings)" in capsys.readouterr().out
+
+    def test_findings_exit_one(self, tmp_path, capsys):
+        p = tmp_path / "bad.py"
+        p.write_text(
+            "def messy():\n"
+            "    try:\n"
+            "        return 1\n"
+            "    except Exception:\n"
+            "        pass\n"
+        )
+        assert self._main([str(p)]) == 1
+        assert "broad-except" in capsys.readouterr().out
+
+    def test_unreadable_source_is_findings_not_usage(
+        self, tmp_path, capsys
+    ):
+        p = tmp_path / "torn.py"
+        p.write_text("def broken(:\n")
+        assert self._main([str(p)]) == 1
+        assert "parse-error" in capsys.readouterr().out
+
+    def test_no_arguments_is_usage_error(self, capsys):
+        with pytest.raises(SystemExit) as ei:
+            self._main([])
+        assert ei.value.code == 2
+
+
 class TestSelfLint:
     def test_self_lint_clean(self):
         findings = analysis.self_lint()

@@ -428,6 +428,42 @@ class TestEngineWarmRestart:
         assert eng.metrics.prefill_compiles == 0
         assert eng.metrics.decode_compiles == 0
 
+    def test_warm_restart_zero_reanalysis(
+        self, model, warm_cache, monkeypatch
+    ):
+        """The L3 summaries (collective census + per-chip memory) ride
+        the artifact metadata: a warm restart reads them back instead
+        of re-extracting HLO / re-running the memory analysis — zero
+        re-analysis, same discipline as zero fresh traces."""
+        import paddle_tpu.analysis.compiled as ac
+
+        root, _ = warm_cache
+
+        def _boom(compiled):
+            raise AssertionError(
+                "program_summary re-extracted on a warm restart"
+            )
+
+        monkeypatch.setattr(ac, "program_summary", _boom)
+        eng = Engine(model, _engine_config(root))
+        assert eng.metrics.decode_compiles == 0
+        # per-program predicted peaks came from the meta sidecar
+        assert eng.metrics.program_bytes.get("decode", 0) > 0
+        # ...and the L3 rules re-evaluate over the stored summaries
+        report = eng.check_compiled_programs()
+        assert not report.errors, report.render()
+        assert eng.health()["predicted_peak_bytes_per_chip"] > 0
+
+    def test_manifest_entries_carry_memory(self, warm_cache):
+        root, _ = warm_cache
+        mdir = os.path.join(root, "manifests")
+        (mname,) = os.listdir(mdir)
+        with open(os.path.join(mdir, mname)) as f:
+            entries = json.load(f)["entries"]
+        assert entries and all(
+            e.get("memory", 0) > 0 for e in entries
+        )
+
     def test_manifest_lists_program_set(self, warm_cache):
         root, _ = warm_cache
         mdir = os.path.join(root, "manifests")
