@@ -27,6 +27,7 @@ number. An optional scrape thread (``start_scrape_server``) serves
 ``/metrics`` and ``/healthz``.
 """
 from . import flight, jit_events, latency, metrics, scrape, spans
+from . import stepstats
 from .flight import (
     FlightRecorder,
     dump,
@@ -55,6 +56,7 @@ from .scrape import (
     start_scrape_server,
     unregister_health_provider,
 )
+from .stepstats import StepStats, register_stepstats_view
 from .spans import (
     Span,
     current_span,
@@ -79,9 +81,12 @@ __all__ = [
     # flight recorder
     "FlightRecorder", "get_flight_recorder", "record", "dump",
     "find_dumps", "install_signal_handler",
+    # serving step observatory
+    "StepStats", "register_stepstats_view",
     # scrape endpoint
     "ScrapeServer", "start_scrape_server", "register_health_provider",
     "unregister_health_provider", "health_snapshot",
     # submodules
     "flight", "jit_events", "latency", "metrics", "scrape", "spans",
+    "stepstats",
 ]

@@ -11,6 +11,10 @@
                                                        # burn snapshot
     python -m paddle_tpu.observability slo --access-log DIR
                                                        # offline summary
+    python -m paddle_tpu.observability top --url http://host:9100
+                                                       # live per-engine/
+                                                       # per-program
+                                                       # utilization table
 
 Postmortems are written by ``observability.flight.dump`` on watchdog
 trips, unhandled engine errors, and SIGUSR2; they live under
@@ -86,6 +90,26 @@ def _render_dump(payload, out):
                 f"  rid={t.get('rid')} [{t.get('finish_reason')}] "
                 f"{phases}" + (f" {extra}" if extra else "") + "\n"
             )
+    steps = payload.get("step_samples") or []
+    if steps:
+        out.write(
+            f"-- last {len(steps)} step samples " + "-" * 33 + "\n"
+        )
+        for s in steps:
+            progs = " ".join(
+                f"{p}={w:.1f}ms" for p, w in (s.get("launches") or [])
+            )
+            out.write(
+                f"  {_fmt_ts(s.get('ts'))} eng={s.get('engine', '?')}"
+                f" wall={s.get('wall_ms', 0):.1f}ms"
+                f" host={s.get('host_ms', 0):.1f}ms"
+                f" occ={s.get('occupancy', 0):.2f}"
+                f" q={s.get('queue_depth', 0)}"
+                f" tok={s.get('tokens', 0)}"
+                f" kv_headroom={s.get('kv_headroom_blocks', 0)}"
+                + (f" [{progs}]" if progs else "") + "\n"
+            )
+    _render_goodput_summary(payload.get("metrics") or {}, out)
     events = payload.get("events") or []
     if events:
         out.write(f"-- last {len(events)} events " + "-" * 38 + "\n")
@@ -135,6 +159,45 @@ def _render_compilecache_summary(clog, m, out):
         f" load_s="
         f"{total('paddle_tpu_compilecache_load_seconds_total'):.3f}\n"
     )
+
+
+def _render_goodput_summary(m, out):
+    """Aggregate the step-observatory goodput ledger out of a metrics
+    snapshot: ``paddle_tpu_serving_goodput_tokens_total{class=...}``
+    summed per class (across engines), plus the per-engine goodput
+    fraction / MFU gauges when present."""
+    prefix = "paddle_tpu_serving_goodput_tokens_total{"
+    ledger: dict = {}
+    for k, v in m.items():
+        if not k.startswith(prefix):
+            continue
+        labels = dict(
+            part.split("=", 1)
+            for part in k[len(prefix):-1].split(",") if "=" in part
+        )
+        cls = labels.get("class", "?")
+        ledger[cls] = ledger.get(cls, 0) + v
+    if not ledger:
+        return
+    out.write("-- goodput ledger (tokens) " + "-" * 33 + "\n")
+    out.write("  " + " ".join(
+        f"{cls}={ledger[cls]:g}" for cls in sorted(ledger)
+    ) + "\n")
+    for series, label in (
+        ("paddle_tpu_serving_goodput_fraction", "goodput"),
+        ("paddle_tpu_serving_mfu", "mfu"),
+    ):
+        vals = [
+            (k, v) for k, v in sorted(m.items())
+            if k == series or k.startswith(series + "{")
+        ]
+        for k, v in vals:
+            eng = k[len(series):].strip("{}") or ""
+            out.write(
+                f"  {label}"
+                + (f"[{eng}]" if eng else "")
+                + f" = {v:.4f}\n"
+            )
 
 
 _PROM_LINE = None   # compiled lazily in _parse_prom
@@ -238,6 +301,72 @@ def _slo_live(url, out):
         out.write(
             f"burn[{labels.get('signal')}] {scope}: {value:.2f}x"
             + ("  ** BURNING **" if value >= 1.0 else "") + "\n"
+        )
+    return 0
+
+
+def _top_live(url, out):
+    """Live serving-utilization snapshot off a scrape endpoint: the
+    per-engine/per-program step-wall table
+    (``paddle_tpu_serving_step_seconds``), then one utilization line
+    per engine (occupancy / goodput fraction / MFU), then KV headroom
+    per engine and per fleet replica."""
+    import urllib.request
+
+    text = urllib.request.urlopen(
+        url.rstrip("/") + "/metrics", timeout=10
+    ).read().decode()
+    rows: dict = {}
+    for labels, value in _parse_prom(
+        text, "paddle_tpu_serving_step_seconds"
+    ):
+        q = labels.get("quantile")
+        if q is not None:
+            rows.setdefault(
+                f"engine {labels.get('engine', '?')}", {}
+            ).setdefault(labels.get("program", "?"), {})[q] = value
+    for labels, value in _parse_prom(
+        text, "paddle_tpu_serving_step_seconds_count"
+    ):
+        rows.setdefault(
+            f"engine {labels.get('engine', '?')}", {}
+        ).setdefault(labels.get("program", "?"), {})["count"] = value
+    if not rows:
+        out.write("no paddle_tpu_serving_step_seconds series at "
+                  f"{url} (is a serving engine running with "
+                  "stepstats enabled?)\n")
+        return 1
+    _render_slo_table(rows, out)
+    util: dict = {}
+    for series, label in (
+        ("paddle_tpu_serving_occupancy", "occupancy"),
+        ("paddle_tpu_serving_goodput_fraction", "goodput"),
+        ("paddle_tpu_serving_mfu", "mfu"),
+    ):
+        for labels, value in _parse_prom(text, series):
+            util.setdefault(
+                labels.get("engine", "?"), {}
+            )[label] = value
+    for eng in sorted(util):
+        vals = util[eng]
+        out.write(f"engine {eng}: " + " ".join(
+            f"{k}={vals[k]:.3f}"
+            for k in ("occupancy", "goodput", "mfu") if k in vals
+        ) + "\n")
+    for labels, value in _parse_prom(
+        text, "paddle_tpu_serving_kv_headroom_blocks"
+    ):
+        out.write(
+            f"kv headroom: engine {labels.get('engine', '?')}"
+            f" {int(value)} blocks\n"
+        )
+    for labels, value in _parse_prom(
+        text, "paddle_tpu_fleet_replica_kv_headroom_blocks"
+    ):
+        out.write(
+            f"kv headroom: fleet {labels.get('fleet', '?')}"
+            f" replica {labels.get('replica', '?')}"
+            f" {int(value)} blocks\n"
         )
     return 0
 
@@ -350,8 +479,18 @@ def main(argv=None):
     )
     p_slo.add_argument("--ttft-p99-ms", type=float, default=None)
     p_slo.add_argument("--tpot-p99-ms", type=float, default=None)
+    p_top = sub.add_parser(
+        "top",
+        help="live per-engine/per-program serving utilization table",
+    )
+    p_top.add_argument(
+        "--url", required=True,
+        help="scrape endpoint base URL (e.g. http://host:9100)",
+    )
     args = parser.parse_args(argv)
 
+    if args.cmd == "top":
+        return _top_live(args.url, sys.stdout)
     if args.cmd == "slo":
         if bool(args.url) == bool(args.access_log):
             print(

@@ -37,6 +37,7 @@ from collections import deque
 __all__ = [
     "FlightRecorder", "get_flight_recorder", "record", "dump",
     "record_timeline", "timelines",
+    "record_step_sample", "step_samples",
     "dump_dir", "find_dumps", "install_signal_handler",
 ]
 
@@ -46,13 +47,19 @@ _DUMP_PREFIX = "paddle_tpu-flight-"
 class FlightRecorder:
     """Thread-safe bounded event ring."""
 
-    def __init__(self, capacity=512, timeline_capacity=64):
+    def __init__(self, capacity=512, timeline_capacity=64,
+                 step_sample_capacity=64):
         self._events = deque(maxlen=int(capacity))
         # last-N finished/aborted request timelines (serving feeds one
         # phase-breakdown dict per completed request): a postmortem
         # shows what requests were DOING — queue waits, chunk counts,
         # preemptions, hops — not just counters
         self._timelines = deque(maxlen=int(timeline_capacity))
+        # last-N serving step samples (observability/stepstats.py feeds
+        # one per non-idle engine step): the postmortem's view of where
+        # step time went RIGHT BEFORE the failure — launch walls per
+        # program, occupancy, queue depth, KV headroom
+        self._step_samples = deque(maxlen=int(step_sample_capacity))
         self._lock = threading.Lock()
         self.dumps = 0          # postmortems written by this recorder
 
@@ -80,10 +87,21 @@ class FlightRecorder:
         with self._lock:
             return [dict(t) for t in self._timelines]
 
+    def record_step_sample(self, entry):
+        """Append one serving step sample (a JSON-friendly dict; one
+        deque append — same cost contract as :meth:`record`)."""
+        with self._lock:
+            self._step_samples.append(entry)
+
+    def step_samples(self):
+        with self._lock:
+            return [dict(s) for s in self._step_samples]
+
     def clear(self):
         with self._lock:
             self._events.clear()
             self._timelines.clear()
+            self._step_samples.clear()
 
     def __len__(self):
         with self._lock:
@@ -111,6 +129,16 @@ def record_timeline(entry):
 def timelines():
     """The process-wide recorder's last-N request timelines."""
     return _recorder.timelines()
+
+
+def record_step_sample(entry):
+    """Append a serving step sample to the process-wide ring."""
+    _recorder.record_step_sample(entry)
+
+
+def step_samples():
+    """The process-wide recorder's last-N serving step samples."""
+    return _recorder.step_samples()
 
 
 def dump_dir():
@@ -158,6 +186,7 @@ def dump(reason, path=None, probes=None):
             "argv": sys.argv,
             "events": _json_safe(_recorder.events()),
             "request_timelines": _json_safe(_recorder.timelines()),
+            "step_samples": _json_safe(_recorder.step_samples()),
             "compile_log": _json_safe(jit_events.compile_log()),
             "metrics": _json_safe(metrics.get_registry().snapshot()),
             "probes": _json_safe(probes or {}),

@@ -68,6 +68,12 @@ docs/resilience.md):
                        "chrome_trace") — exporter/scrape failures must
                        degrade to a logged warning, never crash the
                        training or serving they observe
+    obs.stepstats      one serving step-observatory sample (context:
+                       engine=), fired at the step tail before the
+                       sample folds — a crashing sampler warns once
+                       and disables itself (the engine drops its
+                       StepStats; the weakref collector view follows),
+                       never perturbing the step that carried it
 
 Every injected fault is itself telemetry: the moment a spec fires it is
 counted in ``paddle_tpu_resilience_fault_fires_total{site}`` and logged

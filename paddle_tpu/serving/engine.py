@@ -82,6 +82,7 @@ from __future__ import annotations
 import collections
 import itertools
 import time
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -155,7 +156,8 @@ class EngineConfig:
                  speculate_ngram=3, decode_kernel="auto",
                  kv_cache_dtype=None, journal=None, access_log=None,
                  slo=None, tp_degree=1, devices=None,
-                 tp_numerics="exact", device_memory_budget=None):
+                 tp_numerics="exact", device_memory_budget=None,
+                 stepstats=True, stepstats_ring=256):
         if max_batch_slots < 1:
             raise ValueError("max_batch_slots must be >= 1")
         if page_size < 1 or max_model_len < 2:
@@ -393,6 +395,19 @@ class EngineConfig:
                     f"got {device_memory_budget}"
                 )
         self.device_memory_budget = device_memory_budget
+        # serving step observatory (observability/stepstats.py): every
+        # step folds into per-program launch-wall digests, a goodput
+        # ledger, and a bounded sample ring of the last
+        # ``stepstats_ring`` non-idle steps — host-side bumps on the
+        # hot path, rendered pull-time only. stepstats=False removes
+        # the sampler entirely (the bench overhead floor).
+        self.stepstats = bool(stepstats)
+        stepstats_ring = int(stepstats_ring)
+        if stepstats_ring < 1:
+            raise ValueError(
+                f"stepstats_ring must be >= 1, got {stepstats_ring}"
+            )
+        self.stepstats_ring = stepstats_ring
         self.seed = int(seed)
 
 
@@ -579,6 +594,28 @@ class Engine:
                 capacity_blocks=cfg.prefix_cache_blocks,
                 metrics=self.metrics,
             )
+        # step observatory (observability/stepstats.py): per-program
+        # launch-wall digests, goodput ledger, bounded sample ring,
+        # live MFU — registered as its own weakref collector view. A
+        # sampler crash (the obs.stepstats fault site) warns once and
+        # disables it; serving never perturbs (_disable_stepstats).
+        self.stepstats = None
+        self._stepstats_warned = False
+        if cfg.stepstats:
+            from ..observability.stepstats import (
+                StepStats, register_stepstats_view,
+            )
+
+            self.stepstats = StepStats(
+                adapter=self.adapter, tp_degree=cfg.tp_degree,
+                shard_degree=self.pool.shard_degree,
+                ring=cfg.stepstats_ring,
+            )
+            register_stepstats_view(self.stepstats, self.engine_id)
+        # KV headroom gauge (free + reclaimable blocks): what the
+        # fleet's headroom-aware router weighs; meaningful from build
+        # (an engine that never stepped has the whole pool free)
+        self.metrics.kv_headroom_blocks = self.block_manager.num_free
         if cfg.analysis_check is not None:
             # the consolidated gate (L1 jaxpr checks over every enabled
             # program family + the L3 compiled checks when summaries
@@ -1784,6 +1821,9 @@ class Engine:
         req.num_cached = 0
         req.slot = None
         req.state = RequestState.WAITING
+        # goodput attribution: the re-prefill recomputes context built
+        # on another replica — migration waste, not preemption
+        req.resume_cause = "migration"
         self.waiting.appendleft(req)
         self.metrics.requests_received += 1
         req.timeline.resumes += 1
@@ -1915,6 +1955,8 @@ class Engine:
             # abort()): their slots/blocks were already released
             finished.extend(self._aborted)
             self._aborted.clear()
+        if self.stepstats is not None:
+            self.stepstats.begin_step()
         try:
             self._expire(finished)
             self._admit(finished)
@@ -1961,6 +2003,29 @@ class Engine:
         if self.prefix_cache is not None:
             m.prefix_cache_blocks = len(self.prefix_cache)
         m.pool_high_water = bm.high_water
+        m.kv_headroom_blocks = bm.num_free + m.kv_reclaimable_blocks
+        st = self.stepstats
+        if st is not None:
+            try:
+                faults.fire("obs.stepstats", engine=self.engine_id)
+                sample = st.end_step(
+                    occupancy=(
+                        m.num_running / self.config.max_batch_slots
+                    ),
+                    queue_depth=m.queue_depth,
+                    kv_free_blocks=bm.num_free,
+                    kv_reclaimable_blocks=m.kv_reclaimable_blocks,
+                )
+                if sample is not None:
+                    # the flight recorder's bounded step-sample ring:
+                    # a postmortem shows the last N steps' attribution
+                    _flight.record_step_sample(
+                        dict(sample, engine=self.engine_id)
+                    )
+            except Exception as e:  # analysis: allow(broad-except)
+                # degradable by contract: the observatory must never
+                # take the step down with it
+                self._disable_stepstats(e)
         return finished
 
     def health(self):
@@ -2058,6 +2123,20 @@ class Engine:
             "kv_utilization": util,
             "kv_active_utilization": util_active,
             "kv_reclaimable_blocks": reclaimable,
+            # headroom the router weighs: blocks this replica could
+            # still absorb (free + reclaimable), plus the per-chip
+            # byte view so heterogeneous-width slices compare fairly
+            "kv_headroom_blocks": bm.num_free + reclaimable,
+            "kv_headroom_bytes_per_chip": int(
+                (bm.num_free + reclaimable)
+                * self.pool.block_bytes_per_chip()
+            ),
+            # step observatory summary (None = sampler disabled):
+            # per-program step walls, goodput ledger, occupancy, MFU
+            "stepstats": (
+                self.stepstats.summary()
+                if self.stepstats is not None else None
+            ),
             "prefix_cache_blocks": (
                 len(self.prefix_cache)
                 if self.prefix_cache is not None else 0
@@ -2190,6 +2269,35 @@ class Engine:
                     self._poison(req, e, finished)
                     continue
 
+    def _disable_stepstats(self, exc):
+        """``obs.stepstats`` degradation: a crashing sampler is warned
+        ONCE and dropped — its collector view unregisters through the
+        weakref at the next scrape — and serving continues without the
+        observatory. The step itself must never pay for a sampler
+        failure."""
+        if not self._stepstats_warned:
+            self._stepstats_warned = True
+            warnings.warn(
+                f"step observatory disabled for engine "
+                f"{self.engine_id} after sampler failure: "
+                f"{type(exc).__name__}: {exc}",
+                RuntimeWarning, stacklevel=2,
+            )
+        self.stepstats = None
+
+    def _stepstats_launch(self, program, t0):
+        """Record one device launch wall for the observatory. ``t0``
+        was taken immediately before the launch block, whose body ends
+        with the host-side sync — so the wall is device-inclusive
+        block-until-ready time, with zero effect on traced code."""
+        st = self.stepstats
+        if st is None:
+            return
+        try:
+            st.record_launch(program, time.perf_counter() - t0)
+        except Exception as e:  # analysis: allow(broad-except) degradable
+            self._disable_stepstats(e)
+
     def _watch(self, tag):
         """Hung-step detection: launches run under the comm watchdog
         when one is enabled (serving's analogue of watchdog-tracked
@@ -2213,6 +2321,7 @@ class Engine:
         table = np.zeros(cfg.pages_per_seq, np.int32)
         table[: len(req.block_ids)] = req.block_ids
         p = req.sampling_params
+        _t0 = time.perf_counter()
         with span(
             "serving.prefill", request_id=req.request_id, bucket=bucket,
         ), self._watch("serving.prefill"), jit_events.watch(
@@ -2254,12 +2363,23 @@ class Engine:
                     e._kv_pool_unsafe = True
                 raise
             tok = int(tok)
+        self._stepstats_launch("prefill", _t0)
         self.pool.rebind(k, v)
         req.num_cached = len(tokens)
         self.metrics.prefill_tokens += len(tokens)
         self.metrics.prefill_steps += 1
         req.timeline.prefill_chunks += 1
         req.timeline.prefill_tokens += len(tokens)
+        st = self.stepstats
+        if st is not None:
+            # goodput: a re-prefill over already-produced context
+            # (output tokens exist) recomputes, attributed to the
+            # preemption or migration that forced it
+            st.note_prefill(
+                len(tokens),
+                cause=(req.resume_cause or "preempt")
+                if req.output_token_ids else None,
+            )
         self._finish_prefill(req, tok)
 
     def _finish_prefill(self, req, tok):
@@ -2353,6 +2473,7 @@ class Engine:
         p = req.sampling_params
         cache_len = req.num_cached
         any_sample = bool(p.do_sample) and final
+        _t0 = time.perf_counter()
         with span(
             "serving.prefill_ext", request_id=req.request_id,
             bucket=bucket, cache_len=cache_len,
@@ -2385,6 +2506,7 @@ class Engine:
                 raise
             if final:
                 tok = int(tok)
+        self._stepstats_launch("prefill_ext", _t0)
         self.pool.rebind(k, v)
         req.num_cached = cache_len + len(chunk)
         self.metrics.prefill_tokens += len(chunk)
@@ -2392,6 +2514,15 @@ class Engine:
         self.metrics.prefill_chunks += 1
         req.timeline.prefill_chunks += 1
         req.timeline.prefill_tokens += len(chunk)
+        st = self.stepstats
+        if st is not None:
+            # same recompute attribution as _prefill: every chunk of a
+            # resumed request rebuilds cache it already had
+            st.note_prefill(
+                len(chunk),
+                cause=(req.resume_cause or "preempt")
+                if req.output_token_ids else None,
+            )
         if final:
             self._finish_prefill(req, tok)
 
@@ -2400,6 +2531,7 @@ class Engine:
         prefill can diverge from a shared partial block without
         touching the original."""
         self._pin_adapter()
+        _t0 = time.perf_counter()
         with span(
             "serving.cow", src=int(src), dst=int(dst),
         ), self._watch("serving.cow"), jit_events.watch(
@@ -2419,6 +2551,7 @@ class Engine:
                 if self._pool_donated:
                     e._kv_pool_unsafe = True
                 raise
+        self._stepstats_launch("cow", _t0)
         self.pool.rebind(k, v)
         self.metrics.cow_copies += 1
 
@@ -2489,6 +2622,9 @@ class Engine:
         self._release(req)
         req.state = RequestState.WAITING
         req.num_cached = 0
+        # the re-prefill this forces recomputes tokens the ledger
+        # already counted — classify that waste as preemption
+        req.resume_cause = "preempt"
         self.waiting.appendleft(req)
         self.metrics.preemptions += 1
         req.timeline.preemptions += 1
@@ -2570,6 +2706,7 @@ class Engine:
             request_ids=tuple(self.slots[i].request_id for i in idxs),
         )
         any_sample = bool(params["do_sample"].any())
+        _t0 = time.perf_counter()
         with span(
             "serving.decode", active=len(idxs),
         ), self._watch("serving.decode"), jit_events.watch(
@@ -2604,6 +2741,7 @@ class Engine:
                     e._kv_pool_unsafe = True
                 raise
             nxt = np.asarray(nxt)
+        self._stepstats_launch("decode", _t0)
         self.pool.rebind(k, v)
         self.metrics.decode_steps += 1
         return nxt
@@ -2652,7 +2790,7 @@ class Engine:
         )
         if nxt is None:
             return
-        cfg = self.config
+        cfg, st = self.config, self.stepstats
         for i in idxs:
             req = self.slots[i]
             req.num_cached += 1
@@ -2661,6 +2799,8 @@ class Engine:
             req.last_token = tok
             self.metrics.decode_tokens += 1
             req.timeline.decode_tokens += 1
+            if st is not None:
+                st.note_decode(1)
             reason = req.check_stop(cfg.max_model_len)
             if reason:
                 self._finish(req, reason, finished)
@@ -2749,6 +2889,7 @@ class Engine:
             "serving.step", phase="verify",
             request_ids=tuple(self.slots[i].request_id for i in idxs),
         )
+        _t0 = time.perf_counter()
         with span(
             "serving.verify", active=len(idxs),
             proposed=int(draft_lens.sum()),
@@ -2772,6 +2913,7 @@ class Engine:
                     e._kv_pool_unsafe = True
                 raise
             tgt = np.asarray(tgt)
+        self._stepstats_launch("verify", _t0)
         self.pool.rebind(kp, vp)
         self.metrics.verify_steps += 1
         return tokens, draft_lens, tgt
@@ -2794,6 +2936,7 @@ class Engine:
             return
         tokens, draft_lens, tgt = res
         cfg, m = self.config, self.metrics
+        st = self.stepstats
         for i in idxs:
             req = self.slots[i]
             dlen = int(draft_lens[i])
@@ -2808,6 +2951,11 @@ class Engine:
                 m.spec_accepted += a
                 m.record_spec_accept(a)
                 req.timeline.spec_accepted += a
+                if st is not None and dlen > a:
+                    # rejected drafts consumed verify compute for
+                    # tokens nobody keeps — the goodput ledger's
+                    # spec-reject class (== proposed - accepted)
+                    st.note_spec_reject(dlen - a)
             # emit targets 0..a: the accepted drafts' successors plus
             # the bonus token the rejected/terminal position scored.
             # Their K/V is already in the pages (draft j == target j-1
@@ -2822,6 +2970,8 @@ class Engine:
                 req.last_token = tok
                 m.decode_tokens += 1
                 req.timeline.decode_tokens += 1
+                if st is not None:
+                    st.note_decode(1)
                 reason = req.check_stop(cfg.max_model_len)
                 if reason:
                     # stop inside the window (EOS mid-draft, length):
@@ -2841,6 +2991,10 @@ class Engine:
             req.slot = None
 
     def _finish(self, req, reason, finished):
+        if reason == "aborted" and self.stepstats is not None:
+            # the client walked away from every token this request
+            # emitted: reclassify them useful -> wasted in the ledger
+            self.stepstats.note_abort(len(req.output_token_ids))
         if reason in ("timeout", "error"):
             # degradation events belong in the postmortem ring; normal
             # completions (length/eos/stop) would only drown them out

@@ -329,6 +329,12 @@ def _register_view(fleet):
         reclaimable = MetricFamily(
             "paddle_tpu_fleet_replica_kv_reclaimable_blocks", "gauge",
         )
+        # absorbable capacity per replica (free + reclaimable blocks):
+        # the headroom-aware router's input, exported so a capacity
+        # review can see WHY requests routed where they did
+        headroom = MetricFamily(
+            "paddle_tpu_fleet_replica_kv_headroom_blocks", "gauge",
+        )
         # tensor-parallel degree per replica: a router/dashboard must
         # tell a 4-chip replica's capacity from a 1-chip one's
         tp_deg = MetricFamily(
@@ -345,10 +351,11 @@ def _register_view(fleet):
                 pfx_tokens.add(em.prefix_hit_tokens, rl)
                 pfill.add(em.prefill_tokens, rl)
                 reclaimable.add(em.kv_reclaimable_blocks, rl)
+                headroom.add(em.kv_headroom_blocks, rl)
                 tp_deg.add(em.tp_degree, rl)
         fams += [
             up, restarts, pfx_hits, pfx_tokens, pfill, reclaimable,
-            tp_deg,
+            headroom, tp_deg,
         ]
         # replica lifecycle states, zero-filled over every state so a
         # scale event is a visible edge (0->1 spawning, 1->0 live, ...)
@@ -1573,7 +1580,10 @@ class Fleet:
                     # everyone else was fuller, so halting the sweep
                     # was right; an affinity refusal says nothing
                     # about the other candidates
-                    fallback = min(loads, key=loads.get)
+                    fallback = min(
+                        loads,
+                        key=lambda s: self._route_weight(s, loads),
+                    )
                     if fallback is not target:
                         placed = self._place(freq, fallback)
                         if placed:
@@ -1614,27 +1624,55 @@ class Fleet:
             return False
         return True
 
+    def _route_weight(self, sup, loads):
+        """Capacity-aware routing key, ascending-better, shared by
+        every least-loaded pick (:meth:`_route_target`'s fallback and
+        tie-breaks, :meth:`_dispatch_one`'s affinity-refusal retry):
+
+        1. tp_degree-normalized load — a tp=4 slice runs each step
+           across 4 chips' compute, so at equal raw backlog it is the
+           LESS loaded candidate; dividing by width makes
+           heterogeneous slices (tp=4 next to tp=2) absorb traffic
+           proportionally instead of the narrow replica saturating
+           first.
+        2. per-chip KV headroom as the tie-break — free + reclaimable
+           blocks scaled by the pool's shard degree (a sharded pool
+           holds ~1/tp of each block per chip), negated so MORE
+           absorbable capacity sorts first.
+        """
+        eng = sup.engine
+        load = loads[sup]
+        if eng is None:
+            return (float(load), 0.0)
+        tp = max(1, getattr(eng.config, "tp_degree", 1))
+        shard = max(1, getattr(eng.pool, "shard_degree", 1))
+        return (
+            load / tp,
+            -eng.metrics.kv_headroom_blocks / shard,
+        )
+
     def _route_target(self, freq, loads, digests=None):
         """Hit-aware placement: among the routable candidates
         (``loads``), prefer the replica whose prefix cache already
         holds the longest chain match for this prompt — its shared
         blocks are forked instead of recomputed, which is exactly the
         prefill compute a least-loaded bounce would throw away. Ties
-        on match length break least-loaded; zero matches anywhere
-        falls back to plain least-loaded. Affinity is load-bounded: a
-        match of n blocks only overrides load while the warm replica
-        carries fewer than n extra requests over the least-loaded
-        candidate — saving n blocks of prefill is not worth queueing
-        behind an arbitrarily deep backlog, so a saturated replica
-        with a shallow match cannot capture all matching traffic.
-        Resume placements (failover) benefit identically: the
-        re-prefill over prompt + output[:-1] starts with the same
-        prompt digests. ``digests`` carries the per-replica digest-set
-        snapshots across one dispatch sweep; the prompt's own digests
-        are cached on the FleetRequest (hashed once per lifetime, not
-        per parked-retry sweep). Returns ``(supervisor,
-        used_affinity)`` — the caller books the prefix-hit counter
-        only once the placement actually lands."""
+        on match length break on :meth:`_route_weight` (tp-normalized
+        load, then per-chip KV headroom); zero matches anywhere falls
+        back to the same weighted least-loaded pick. Affinity is
+        load-bounded: a match of n blocks only overrides load while
+        the warm replica carries fewer than n extra requests over the
+        least-loaded candidate — saving n blocks of prefill is not
+        worth queueing behind an arbitrarily deep backlog, so a
+        saturated replica with a shallow match cannot capture all
+        matching traffic. Resume placements (failover) benefit
+        identically: the re-prefill over prompt + output[:-1] starts
+        with the same prompt digests. ``digests`` carries the
+        per-replica digest-set snapshots across one dispatch sweep;
+        the prompt's own digests are cached on the FleetRequest
+        (hashed once per lifetime, not per parked-retry sweep).
+        Returns ``(supervisor, used_affinity)`` — the caller books the
+        prefix-hit counter only once the placement actually lands."""
         best, best_len = None, 0
         if digests is None:
             digests = {}
@@ -1660,12 +1698,17 @@ class Fleet:
             if loads[sup] - min_load >= n:
                 continue  # too backlogged for what the match saves
             if n > best_len or (
-                n == best_len and n > 0 and loads[sup] < loads[best]
+                n == best_len and n > 0
+                and self._route_weight(sup, loads)
+                < self._route_weight(best, loads)
             ):
                 best, best_len = sup, n
         if best is not None and best_len > 0:
             return best, True
-        return min(loads, key=loads.get), False
+        return (
+            min(loads, key=lambda s: self._route_weight(s, loads)),
+            False,
+        )
 
     def _maybe_hedge(self, now):
         deadline = self.config.hedge_after_s

@@ -325,3 +325,12 @@ class KVPool:
         return self.per_chip_nbytes() / (
             self.num_blocks * self.block_size
         )
+
+    def block_bytes_per_chip(self):
+        """Per-chip bytes one KV block occupies on the most-loaded
+        device — the unit the headroom snapshot scales free blocks by,
+        so a tp=4 replica's N free blocks read as ~half the per-chip
+        bytes a tp=2 replica's N blocks do (``Engine.health()``'s
+        ``kv_headroom_bytes_per_chip`` and the fleet router's
+        headroom weighting)."""
+        return self.per_chip_nbytes() / self.num_blocks

@@ -57,6 +57,7 @@ _EXPORT_KINDS = {
     "cache_utilization": ("gauge", ""),
     "kv_active_utilization": ("gauge", ""),
     "kv_reclaimable_blocks": ("gauge", ""),
+    "kv_headroom_blocks": ("gauge", ""),
     "prefix_cache_blocks": ("gauge", ""),
     "pool_high_water": ("gauge", ""),
     "mean_ttft_s": ("gauge", ""),
@@ -212,6 +213,10 @@ class EngineMetrics:
         # and routing must see THIS, not raw utilization
         self.kv_active_utilization = 0.0
         self.kv_reclaimable_blocks = 0
+        # free + reclaimable blocks: the capacity this replica could
+        # still absorb (set at engine build, refreshed each step) —
+        # what headroom-aware fleet routing weighs
+        self.kv_headroom_blocks = 0
         self.prefix_cache_blocks = 0
         self.pool_high_water = 0
         # latency digests: one mergeable quantile sketch per phase
@@ -307,6 +312,7 @@ class EngineMetrics:
             "cache_utilization": self.cache_utilization,
             "kv_active_utilization": self.kv_active_utilization,
             "kv_reclaimable_blocks": self.kv_reclaimable_blocks,
+            "kv_headroom_blocks": self.kv_headroom_blocks,
             "prefix_cache_blocks": self.prefix_cache_blocks,
             "pool_high_water": self.pool_high_water,
             "mean_ttft_s": self.mean_ttft,

@@ -306,6 +306,11 @@ class Request:
         # durability: output tokens already written to the request
         # journal (the emit cursor; journal.admit/emit own it)
         self.journal_cursor = 0
+        # goodput attribution for a forced re-prefill: "preempt"
+        # (in-engine recompute preemption) or "migration" (fleet
+        # failover/scale-down resume) — the step observatory's ledger
+        # classifies the recomputed tokens by this
+        self.resume_cause = None
         # multi-tenant QoS attribution (serving/qos.py); None for
         # in-process callers. Journaled in ADMIT ("tn") so replay
         # restores per-tenant accounting.

@@ -52,6 +52,7 @@ from ..observability.scrape import (
     ObservabilityHandler, ThreadedHTTPHost, register_health_provider,
     unregister_health_provider,
 )
+from ..observability.spans import remote_span, span
 from ..resilience import faults
 from .engine import EngineOverloadedError
 from .qos import QoS, QoSConfig, QoSRejection, UnknownTenantError
@@ -71,6 +72,29 @@ _SAMPLING_FIELDS = (
 )
 
 _RESPONSE_CLASSES = ("2xx", "3xx", "4xx", "5xx")
+
+
+def _parse_traceparent(header):
+    """W3C ``traceparent`` (``00-<32hex trace>-<16hex span>-<flags>``)
+    -> this repo's ``"<trace_id>-<span_id>"`` propagation string; None
+    for a missing/malformed header (the caller then mints a fresh
+    trace root, so every admitted request carries SOME trace id into
+    the access log)."""
+    if not header or not isinstance(header, str):
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace, parent, _flags = parts
+    if len(trace) != 32 or len(parent) != 16 or len(version) != 2:
+        return None
+    try:
+        int(version, 16), int(trace, 16), int(parent, 16)
+    except ValueError:
+        return None
+    if trace == "0" * 32 or parent == "0" * 16:
+        return None
+    return f"{trace}-{parent}"
 
 
 class _ServerMetrics:
@@ -566,7 +590,19 @@ class Server(ThreadedHTTPHost):
                 400, "invalid_request_error", str(e),
                 param=_param_from_message(e),
             )
-        stream = self._submit(prompt, params, tenant)
+        # trace propagation: an inbound W3C traceparent continues the
+        # caller's trace; without one a fresh root is minted. Either
+        # way the span is open across Request creation, so
+        # Request.trace_id (and thus the access-log "trace" field)
+        # carries the distributed trace id
+        tp = _parse_traceparent(handler.headers.get("traceparent"))
+        ctx = (
+            remote_span("http.completion", tp, tenant=tenant)
+            if tp is not None
+            else span("http.completion", tenant=tenant)
+        )
+        with ctx:
+            stream = self._submit(prompt, params, tenant)
         stream.streaming = streaming
         return stream, body
 
@@ -599,13 +635,16 @@ class Server(ThreadedHTTPHost):
                 }})
                 return
         out = stream.output
+        rid_headers = {"x-request-id": str(stream.req.request_id)}
         if out.finish_reason == "error":
             handler._send_json(500, {"error": {
                 "type": "internal_error",
                 "message": out.error or "request errored",
-            }})
+            }}, headers=rid_headers)
             return
-        handler._send_json(200, self._completion_body(stream, out))
+        handler._send_json(
+            200, self._completion_body(stream, out), headers=rid_headers
+        )
 
     def _stream_response(self, handler, stream):
         """SSE: chunks of new token ids as they land (the handler's
@@ -623,6 +662,7 @@ class Server(ThreadedHTTPHost):
             )
             handler.send_header("Cache-Control", "no-cache")
             handler.send_header("Connection", "close")
+            handler.send_header("x-request-id", str(rid))
             handler.end_headers()
         except OSError:
             self._client_gone(stream)

@@ -565,3 +565,78 @@ class TestHitAwareRouting:
         assert cold.engine.metrics.prefill_tokens == 0
         snap = fleet.snapshot()
         assert snap["route_prefix_hits"] >= 1
+
+
+class TestHeadroomRouting:
+    """Capacity-aware placement (_route_weight): tp_degree-normalized
+    load first, per-chip KV headroom as the tie-break — ROADMAP item-1
+    remainder (heterogeneous-width fleets route by normalized load and
+    per-chip KV headroom)."""
+
+    def test_headroom_breaks_equal_prefix_depth_tie(self, model):
+        fleet = Fleet(model, _engine_config(enable_prefix_cache=True),
+                      FleetConfig(num_replicas=2, analysis_check=None))
+        a, b = fleet.replicas
+        sys_prefix = list(range(40, 52))        # 3 full blocks
+        params = SamplingParams(max_new_tokens=2)
+        # warm BOTH replicas with the same chain: affinity alone can no
+        # longer separate them (equal prefix depth)
+        for sup in (a, b):
+            sup.engine.generate([sys_prefix + [90, 91]], params)
+        assert (
+            a.engine.health()["prefix_cache_digests"]
+            == b.engine.health()["prefix_cache_digests"]
+        )
+        freq = fleet.add_request(sys_prefix + [95, 96], params)
+        loads = {a: 0, b: 0}
+        a.engine.metrics.kv_headroom_blocks = 4
+        b.engine.metrics.kv_headroom_blocks = 12
+        target, affinity = fleet._route_target(freq, loads)
+        assert affinity and target is b
+        a.engine.metrics.kv_headroom_blocks = 12
+        b.engine.metrics.kv_headroom_blocks = 4
+        target, affinity = fleet._route_target(freq, loads)
+        assert affinity and target is a
+        fleet.abort(freq.request_id)
+
+    def test_width_normalized_load_and_per_chip_headroom(self, model):
+        """Direct _route_weight pins: a wider slice at equal raw
+        backlog is the less-loaded candidate, and a sharded pool's
+        headroom counts per chip."""
+        fleet = Fleet(model, _engine_config(),
+                      FleetConfig(num_replicas=2, analysis_check=None))
+        a, b = fleet.replicas
+        loads = {a: 2, b: 2}
+        # tp=2 next to tp=1 at the same raw backlog: the wide replica
+        # runs each step across twice the compute, so it must win
+        # (replicas share one EngineConfig object — give the wide one
+        # its own copy before skewing the width)
+        import copy
+
+        a.engine.config = copy.copy(a.engine.config)
+        a.engine.config.tp_degree = 2
+        a.engine.metrics.kv_headroom_blocks = 8
+        b.engine.metrics.kv_headroom_blocks = 8
+        wa, wb = (
+            fleet._route_weight(a, loads), fleet._route_weight(b, loads)
+        )
+        assert wa < wb and wa[0] == 1.0 and wb[0] == 2.0
+        freq = fleet.add_request(
+            [7, 8, 9], SamplingParams(max_new_tokens=2)
+        )
+        target, affinity = fleet._route_target(freq, loads)
+        assert not affinity and target is a
+        # equal width: per-chip headroom decides (shard_degree scales
+        # the same raw block count down on the sharded pool)
+        a.engine.config.tp_degree = 1
+        a.engine.metrics.kv_headroom_blocks = 8
+        b.engine.metrics.kv_headroom_blocks = 8
+        a.engine.pool.shard_degree = 2
+        try:
+            assert (
+                fleet._route_weight(b, loads)
+                < fleet._route_weight(a, loads)
+            )
+        finally:
+            a.engine.pool.shard_degree = 1
+        fleet.abort(freq.request_id)

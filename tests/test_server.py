@@ -197,6 +197,59 @@ def test_blocking_response_matches_in_process(server, oracle):
     }
 
 
+def test_traceparent_honored_and_request_id_returned(model, tmp_path):
+    """Trace propagation at the front door: an inbound W3C
+    ``traceparent`` is continued into the access log's ``trace``
+    field, a missing/malformed header mints a fresh root, and every
+    response (blocking and streaming) carries ``x-request-id``."""
+    eng = Engine(model, _engine_config(access_log=str(tmp_path)))
+    srv = Server(eng, port=0)
+    try:
+        tid = "ab" * 16
+        status, headers, body = _post(
+            srv.port, {"prompt": PROMPT, "max_tokens": 2},
+            headers={"traceparent": f"00-{tid}-{'cd' * 8}-01"},
+        )
+        assert status == 200
+        rid_traced = headers.get("x-request-id")
+        assert rid_traced == body["id"]
+        status, headers2, _ = _post(
+            srv.port, {"prompt": PROMPT, "max_tokens": 2},
+            headers={"traceparent": "not-a-traceparent"},
+        )
+        assert status == 200
+        rid_minted = headers2.get("x-request-id")
+        assert rid_minted and rid_minted != rid_traced
+        # the SSE path answers the header before the first chunk
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", srv.port, timeout=120
+        )
+        try:
+            conn.request(
+                "POST", "/v1/completions",
+                body=json.dumps({
+                    "prompt": PROMPT, "max_tokens": 2, "stream": True,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert resp.getheader("x-request-id")
+            resp.read()
+        finally:
+            conn.close()
+    finally:
+        srv.close()
+    recs = [
+        json.loads(line)
+        for p in tmp_path.iterdir()
+        for line in p.read_text().splitlines() if line.strip()
+    ]
+    traces = {str(r["rid"]): r["trace"] for r in recs}
+    assert traces[rid_traced] == tid          # inbound trace honored
+    assert traces[rid_minted] and traces[rid_minted] != tid
+
+
 def test_stream_byte_parity_zero_compiles_warm(server, fleet, oracle):
     ref = oracle.generate([PROMPT], SamplingParams(max_new_tokens=N_NEW))[0]
     # first pass warms every trace the server path needs...

@@ -35,10 +35,28 @@ FORWARD_CASES = [
     (M.inception_v3, dict(num_classes=7), 96),
 ]
 
+# the heaviest forward compiles (densenet/inception/googlenet ~19/17/13s,
+# mobilenet_v3 small/large ~15/11s, vgg11 ~7s of tier-1 budget on the
+# 1-core CPU mesh) ride the slow lane; the remaining seven keep the
+# forward-contract sweep in tier-1 — every family still has a tier-1
+# representative (mobilenet v1/v2, vgg16-bn, squeezenet both, alexnet,
+# shufflenet). See the tier-1 wall-time floor note in ROADMAP.md.
+_SLOW_FORWARD = {
+    M.densenet121, M.inception_v3, M.googlenet,
+    M.mobilenet_v3_small, M.mobilenet_v3_large, M.vgg11,
+}
+
 
 @pytest.mark.parametrize(
-    "builder,kwargs,hw", FORWARD_CASES,
-    ids=[b.__name__ for b, _, _ in FORWARD_CASES],
+    "builder,kwargs,hw",
+    [
+        pytest.param(
+            b, kw, hw,
+            marks=(pytest.mark.slow,) if b in _SLOW_FORWARD else (),
+            id=b.__name__,
+        )
+        for b, kw, hw in FORWARD_CASES
+    ],
 )
 def test_forward_shape(builder, kwargs, hw):
     paddle.seed(0)
@@ -62,16 +80,20 @@ def test_lenet_forward():
 @pytest.mark.parametrize(
     "builder",
     [
-        M.mobilenet_v2,
-        # ~28s of tier-1 budget; mobilenet_v2 keeps the tier-1
-        # smoke-train contract covered, the v3 variant rides the slow
-        # lane with vgg16
+        # the tier-1 holder of the smoke-train contract: the cheapest
+        # robustly-descending model (~16s; loss drops three orders of
+        # magnitude in 6 steps). The VERDICT-named v2/v3/vgg16 variants
+        # stay covered on the slow lane
+        M.mobilenet_v1,
+        # ~25s of tier-1 budget; mobilenet_v1 keeps the tier-1
+        # smoke-train contract covered
+        pytest.param(M.mobilenet_v2, marks=pytest.mark.slow),
         pytest.param(M.mobilenet_v3_small, marks=pytest.mark.slow),
         # 60s of tier-1 budget for a case that has failed since the
         # seed (jax-drift loss threshold): the slow lane keeps it
         pytest.param(M.vgg16, marks=pytest.mark.slow),
     ],
-    ids=["mobilenet_v2", "mobilenet_v3_small", "vgg16"],
+    ids=["mobilenet_v1", "mobilenet_v2", "mobilenet_v3_small", "vgg16"],
 )
 def test_smoke_train(builder):
     """Staged train steps on a tiny batch: EVAL-mode loss decreases
