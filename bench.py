@@ -778,6 +778,71 @@ def bench_serving(paddle, on_tpu):
         "unit": "ms",
     }))
 
+    # ---- host KV spill tier (serving/spill.py): a num_blocks-starved
+    # pool drives preemption thrash; the spill-on engine swaps victims'
+    # KV to host RAM and restores at re-admission instead of
+    # re-prefilling. Floor-pair against the identical spill-off engine
+    # (whose preemptions recompute), greedy outputs asserted
+    # byte-identical — the rows are restore latency and the fraction of
+    # preemptions that resumed through a restore (contract: >= 0.9).
+    sp_slots, sp_mml, sp_blocks = (8, 256, 48) if on_tpu else (4, 32, 10)
+    rng = np.random.RandomState(11)
+    sp_prompts = [
+        rng.randint(1, cfg.vocab_size, rng.randint(6, sp_mml // 4)
+                    ).tolist()
+        for _ in range(sp_slots * 2)
+    ]
+    sp_params = [
+        SamplingParams(
+            max_new_tokens=int(rng.randint(sp_mml // 8, sp_mml // 4)),
+            do_sample=False,
+        )
+        for _ in range(sp_slots * 2)
+    ]
+    sp_kw = dict(
+        max_batch_slots=sp_slots, max_model_len=sp_mml,
+        page_size=16 if on_tpu else 4, num_blocks=sp_blocks,
+    )
+    eng_off = Engine(model, EngineConfig(**sp_kw))
+    eng_sp = Engine(model, EngineConfig(
+        **sp_kw, host_spill_bytes=256 * 1024 * 1024,
+    ))
+    outs_off = eng_off.generate(sp_prompts, sp_params)   # warm + thrash
+    m_sp, tier = eng_sp.metrics, eng_sp.spill
+    pre0 = m_sp.preemptions
+    s0 = tier.stats()
+    outs_sp = eng_sp.generate(sp_prompts, sp_params)
+    assert ([o.token_ids for o in outs_sp]
+            == [o.token_ids for o in outs_off]), "spill broke parity"
+    s1 = tier.stats()
+    preempts = m_sp.preemptions - pre0
+    restores = s1["restore_hits"] - s0["restore_hits"]
+    restore_fraction = restores / preempts if preempts else 1.0
+    n_restores = s1["restores"] - s0["restores"]
+    restore_ms = (
+        (s1["restore_seconds_total"] - s0["restore_seconds_total"])
+        / n_restores * 1e3 if n_restores else 0.0
+    )
+    log(f"[serving] spill tier: {preempts} preemptions, "
+        f"{restores} restored ({restore_fraction:.2f} fraction), "
+        f"restore={restore_ms:.2f}ms/req, "
+        f"spilled={s1['spilled_bytes']['request']/1e3:.0f}KB "
+        f"errors={s1['spill_errors']}+{s1['restore_errors']}")
+    assert restore_fraction >= 0.9 or preempts == 0, (
+        f"preempt-restore fraction {restore_fraction:.2f} below the "
+        f"0.9 contract ({restores}/{preempts})"
+    )
+    print(json.dumps({
+        "metric": "serving_spill_restore_ms",
+        "value": round(restore_ms, 3),
+        "unit": "ms",
+    }))
+    print(json.dumps({
+        "metric": "serving_preempt_restore_fraction",
+        "value": round(restore_fraction, 4),
+        "unit": "fraction",
+    }))
+
     # ---- tensor-parallel sharded engine (serving/sharding.py): the
     # same mixed workload as the headline row through a tp=2 engine —
     # every program one single-launch SPMD program over the 1 x tp

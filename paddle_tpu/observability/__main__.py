@@ -110,6 +110,7 @@ def _render_dump(payload, out):
                 + (f" [{progs}]" if progs else "") + "\n"
             )
     _render_goodput_summary(payload.get("metrics") or {}, out)
+    _render_spill_summary(payload.get("metrics") or {}, out)
     events = payload.get("events") or []
     if events:
         out.write(f"-- last {len(events)} events " + "-" * 38 + "\n")
@@ -198,6 +199,57 @@ def _render_goodput_summary(m, out):
                 + (f"[{eng}]" if eng else "")
                 + f" = {v:.4f}\n"
             )
+
+
+def _render_spill_summary(m, out):
+    """Aggregate the host KV spill tier (serving/spill.py) out of a
+    metrics snapshot: occupancy, restore hit rate, and the per-class
+    spilled/restored byte counters — rendered next to the goodput
+    ledger so a pressure review reads waste and its remedy together."""
+
+    def by_label(series, label):
+        prefix = series + "{"
+        agg: dict = {}
+        for k, v in m.items():
+            if not k.startswith(prefix):
+                continue
+            labels = dict(
+                part.split("=", 1)
+                for part in k[len(prefix):-1].split(",") if "=" in part
+            )
+            key = labels.get(label, "?").strip('"')
+            agg[key] = agg.get(key, 0) + v
+        return agg
+
+    occ = by_label("paddle_tpu_serving_spill_host_bytes", "engine")
+    if not occ:
+        return
+    cap = by_label(
+        "paddle_tpu_serving_spill_host_capacity_bytes", "engine"
+    )
+    hit = by_label("paddle_tpu_serving_spill_restore_hit_rate", "engine")
+    spilled = by_label(
+        "paddle_tpu_serving_spill_spilled_bytes_total", "class"
+    )
+    restored = by_label(
+        "paddle_tpu_serving_spill_restored_bytes_total", "class"
+    )
+    out.write("-- kv spill tier " + "-" * 43 + "\n")
+    for eng in sorted(occ):
+        line = f"  engine {eng}: host={occ[eng]:g}B"
+        if eng in cap:
+            line += f"/{cap[eng]:g}B"
+        if eng in hit:
+            line += f" restore_hit_rate={hit[eng]:.3f}"
+        out.write(line + "\n")
+    if spilled or restored:
+        out.write("  " + " ".join(
+            f"spilled[{cls}]={spilled[cls]:g}B"
+            for cls in sorted(spilled)
+        ) + " " + " ".join(
+            f"restored[{cls}]={restored[cls]:g}B"
+            for cls in sorted(restored)
+        ) + "\n")
 
 
 _PROM_LINE = None   # compiled lazily in _parse_prom
@@ -368,6 +420,31 @@ def _top_live(url, out):
             f" replica {labels.get('replica', '?')}"
             f" {int(value)} blocks\n"
         )
+    # host spill tier under the pool: occupancy + restore hit rate per
+    # engine (the KV-headroom lines' second level — blocks that left
+    # the device but are one device_put from coming back)
+    spill_cap = {
+        labels.get("engine", "?"): value
+        for labels, value in _parse_prom(
+            text, "paddle_tpu_serving_spill_host_capacity_bytes"
+        )
+    }
+    spill_hit = {
+        labels.get("engine", "?"): value
+        for labels, value in _parse_prom(
+            text, "paddle_tpu_serving_spill_restore_hit_rate"
+        )
+    }
+    for labels, value in _parse_prom(
+        text, "paddle_tpu_serving_spill_host_bytes"
+    ):
+        eng = labels.get("engine", "?")
+        line = f"kv spill: engine {eng} host={value:g}B"
+        if eng in spill_cap:
+            line += f"/{spill_cap[eng]:g}B"
+        if eng in spill_hit:
+            line += f" restore_hit_rate={spill_hit[eng]:.3f}"
+        out.write(line + "\n")
     return 0
 
 

@@ -22,7 +22,10 @@ under ``paddle_tpu/observability/``:
   emitted decode/verify tokens that reach a caller) from WASTED work:
   rejected speculation drafts, preemption-recompute tokens, migration
   re-prefill tokens, and tokens of aborted requests (reclassified from
-  useful at abort). The reconciliation identity tests pin:
+  useful at abort). A "restored" resume cause (serving/spill.py swapped
+  the victim's KV back from host RAM instead of recomputing it) counts
+  any residual prefill as useful — the waste the preemption would have
+  caused never happened. The reconciliation identity tests pin:
 
       useful + wasted_preempt + wasted_migration
              == prefill_tokens + decode_tokens - aborted
@@ -173,9 +176,11 @@ class StepStats:
 
     def note_prefill(self, n, cause=None):
         """``n`` prompt tokens computed by a prefill launch. ``cause``
-        None = first-time (useful); "preempt"/"migration" = recompute
-        of already-produced context (wasted)."""
-        if cause is None:
+        None = first-time (useful); "restored" = residual prefill after
+        a host-spill restore rebuilt the context for free (useful — the
+        restore made the recompute unnecessary); "preempt"/"migration"
+        = recompute of already-produced context (wasted)."""
+        if cause is None or cause == "restored":
             self.useful_tokens += n
         elif cause == "migration":
             self.wasted_migration_tokens += n

@@ -74,6 +74,17 @@ docs/resilience.md):
                        and disables itself (the engine drops its
                        StepStats; the weakref collector view follows),
                        never perturbing the step that carried it
+    kv.spill           one KV-block spill to the host tier (context:
+                       key=, cls= "prefix"/"request", nbytes=) — an
+                       injected failure degrades to the old
+                       destructive path (the block is freed, the
+                       request recomputes at resume; warn-once +
+                       spill_errors counter), never fatal
+    kv.restore         one KV-block fetch from the host tier (context:
+                       key=) — an injected failure degrades to the
+                       recompute path the spill replaced (warn-once +
+                       restore_errors counter, no block leak), never
+                       fatal
 
 Every injected fault is itself telemetry: the moment a spec fires it is
 counted in ``paddle_tpu_resilience_fault_fires_total{site}`` and logged

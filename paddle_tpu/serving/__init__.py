@@ -52,6 +52,7 @@ from .request import (
 )
 from .server import Server, serve
 from .sharding import TPSpec, build_tp_mesh
+from .spill import HostSpillTier
 from .supervisor import ReplicaSupervisor
 
 __all__ = [
@@ -59,7 +60,8 @@ __all__ = [
     "Request", "RequestOutput", "RequestState", "RequestTimeline",
     "BlockManager", "KVPool",
     "EngineMetrics", "LlamaServingAdapter", "build_adapter",
-    "PrefixCache", "PrefixMatch", "Journal", "ReplayEntry", "AccessLog",
+    "PrefixCache", "PrefixMatch", "HostSpillTier",
+    "Journal", "ReplayEntry", "AccessLog",
     "Fleet", "FleetConfig", "FleetMetrics", "FleetRequest",
     "NoReplicaError", "ReplicaSupervisor", "TPSpec", "build_tp_mesh",
     "PlacementPlan", "PlacementError", "ScalingPolicy", "Autoscaler",

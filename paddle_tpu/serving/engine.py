@@ -157,7 +157,8 @@ class EngineConfig:
                  kv_cache_dtype=None, journal=None, access_log=None,
                  slo=None, tp_degree=1, devices=None,
                  tp_numerics="exact", device_memory_budget=None,
-                 stepstats=True, stepstats_ring=256):
+                 stepstats=True, stepstats_ring=256,
+                 host_spill_bytes=None, spill_dir=None):
         if max_batch_slots < 1:
             raise ValueError("max_batch_slots must be >= 1")
         if page_size < 1 or max_model_len < 2:
@@ -408,6 +409,26 @@ class EngineConfig:
                 f"stepstats_ring must be >= 1, got {stepstats_ring}"
             )
         self.stepstats_ring = stepstats_ring
+        # hierarchical KV spill tier (serving/spill.py): when
+        # host_spill_bytes is set, prefix-cache eviction and
+        # preemption/release demote KV blocks to a host-RAM LRU of
+        # this many bytes (restored instead of recomputed); spill_dir
+        # adds the compilecache-style disk third tier under it —
+        # host-LRU victims demote to disk and survive the process.
+        if host_spill_bytes is not None:
+            host_spill_bytes = int(host_spill_bytes)
+            if host_spill_bytes < 1:
+                raise ValueError(
+                    f"host_spill_bytes must be >= 1 byte or None, got "
+                    f"{host_spill_bytes}"
+                )
+        self.host_spill_bytes = host_spill_bytes
+        if spill_dir is not None and host_spill_bytes is None:
+            raise ValueError(
+                "EngineConfig(spill_dir=) is the DISK tier under the "
+                "host spill tier: set host_spill_bytes= too"
+            )
+        self.spill_dir = str(spill_dir) if spill_dir is not None else None
         self.seed = int(seed)
 
 
@@ -572,19 +593,56 @@ class Engine:
         # N chips' combined KV budget must never transiently
         # materialize whole on one chip — that transient IS the
         # single-chip RESOURCE_EXHAUSTED ceiling this feature removes
-        self.pool = KVPool(
-            self.adapter.num_layers, self.adapter.num_kv_heads,
-            cfg.num_blocks, cfg.page_size, self.adapter.head_dim, dtype,
-            quant_dtype=cfg.kv_cache_dtype,
-            sharding=(
-                self.tp.pool_sharding if self.tp is not None else None
-            ),
-            shard_degree=(
-                self.tp.tp_degree
-                if self.tp is not None and self.tp.kv_sharded else 1
-            ),
-        )
+        try:
+            self.pool = KVPool(
+                self.adapter.num_layers, self.adapter.num_kv_heads,
+                cfg.num_blocks, cfg.page_size, self.adapter.head_dim,
+                dtype,
+                quant_dtype=cfg.kv_cache_dtype,
+                sharding=(
+                    self.tp.pool_sharding if self.tp is not None
+                    else None
+                ),
+                shard_degree=(
+                    self.tp.tp_degree
+                    if self.tp is not None and self.tp.kv_sharded else 1
+                ),
+            )
+        except Exception as e:
+            from .spill import is_resource_exhausted
+
+            if is_resource_exhausted(e):
+                # OOM-graceful pool growth: a backend allocation
+                # failure becomes an admission-style refusal an
+                # operator (or a fleet supervisor) can act on — shrink
+                # num_blocks, enable kv_cache_dtype="int8", raise
+                # tp_degree — instead of an opaque backend crash
+                raise EngineOverloadedError(
+                    f"KV pool allocation exhausted device memory "
+                    f"({cfg.num_blocks} blocks x {cfg.page_size} "
+                    f"tokens): reduce num_blocks, quantize the cache "
+                    f"(kv_cache_dtype='int8'), or shard it wider "
+                    f"(tp_degree) — {type(e).__name__}: {e}"
+                ) from e
+            raise
         self.block_manager = BlockManager(cfg.num_blocks, cfg.page_size)
+        # host-RAM spill tier under the pool (serving/spill.py): the
+        # prefix cache demotes evicted chain blocks into it, and
+        # preemption/release park whole-request handles there so
+        # re-admission restores instead of recomputing
+        self.spill = None
+        self._spill_seq = 0
+        self._spill_signature = None
+        self._spill_warned = False
+        if cfg.host_spill_bytes is not None:
+            from .spill import HostSpillTier, register_spill_view
+
+            self.spill = HostSpillTier(
+                cfg.host_spill_bytes, spill_dir=cfg.spill_dir,
+                engine_id=self.engine_id,
+            )
+            self._spill_signature = self.pool.block_signature()
+            register_spill_view(self.spill, self.engine_id)
         self.prefix_cache = None
         if cfg.enable_prefix_cache:
             from .prefix_cache import PrefixCache
@@ -593,6 +651,7 @@ class Engine:
                 self.block_manager,
                 capacity_blocks=cfg.prefix_cache_blocks,
                 metrics=self.metrics,
+                spill=self.spill, pool=self.pool,
             )
         # step observatory (observability/stepstats.py): per-program
         # launch-wall digests, goodput ledger, bounded sample ring,
@@ -1880,6 +1939,12 @@ class Engine:
                     break
         if req is None or req.state is RequestState.FINISHED:
             return None
+        # same-host migration rides the spill tier: park the cached
+        # blocks under a handle the SURVIVOR's admission can restore
+        # (tiers cross-lookup within the process; the handle key rides
+        # the Request and the fleet's re-ADMIT journal record). A
+        # cross-host resume simply misses and re-prefills as before.
+        self._spill_request(req)
         self._release(req)
         req.state = RequestState.WAITING
         req.num_cached = 0
@@ -2148,6 +2213,12 @@ class Engine:
                 self.prefix_cache.chain_digests()
                 if self.prefix_cache is not None else []
             ),
+            # host spill tier (serving/spill.py): occupancy, per-class
+            # spilled/restored traffic, restore hit rate — None when
+            # the tier is disabled (host_spill_bytes unset)
+            "spill": (
+                self.spill.stats() if self.spill is not None else None
+            ),
             # speculation economics: accepted / proposed draft tokens
             # (None until the first proposal)
             "spec_accept_rate": m.spec_accept_rate,
@@ -2195,8 +2266,13 @@ class Engine:
         while self.waiting and None in self.slots:
             req = self.waiting[0]
             tokens = req.tokens_to_prefill()
+            # restore-instead-of-recompute: a preempted/released
+            # request carrying a live spill handle skips the prefix
+            # lookup — its OWN cached blocks come back from the host
+            # tier (full block budget still allocated below)
+            restore_tokens = self._spill_restorable(req, tokens)
             match = None
-            if self.prefix_cache is not None:
+            if restore_tokens is None and self.prefix_cache is not None:
                 # at least one token must remain to prefill: its logits
                 # seed the first sampled token
                 match = self.prefix_cache.lookup(
@@ -2225,11 +2301,20 @@ class Engine:
                 if not bm.can_allocate(n_alloc):
                     break
             self.waiting.popleft()
-            if self.prefix_cache is not None:
+            if restore_tokens is None and self.prefix_cache is not None:
                 # one lookup per ADMISSION (blocked retries don't count;
                 # neither do they touch the LRU — see lookup/commit)
                 self.metrics.prefix_lookups += 1
-            if match is not None:
+            if restore_tokens is not None:
+                req.block_ids = bm.allocate(n_alloc)
+                # a failed restore keeps the blocks and recomputes:
+                # num_cached=0 sends the whole prompt back through
+                # prefill — exactly the pre-spill preemption path
+                req.num_cached = (
+                    restore_tokens
+                    if self._spill_restore(req, restore_tokens) else 0
+                )
+            elif match is not None:
                 bm.fork(match.shared_blocks)
                 req.block_ids = list(match.shared_blocks) + bm.allocate(
                     n_alloc
@@ -2268,6 +2353,25 @@ class Engine:
                         raise  # donated pool may be gone
                     self._poison(req, e, finished)
                     continue
+            if (restore_tokens is not None
+                    and req.num_cached >= len(tokens)):
+                # fully-covered restore: the cache already holds
+                # prompt + output[:-1], exactly the pre-preemption
+                # decode state — no prefill launch at all. Straight to
+                # RUNNING with the last token re-armed; the goodput
+                # ledger's preempt_recompute class books ZERO tokens.
+                req.state = RequestState.RUNNING
+                req.last_token = req.output_token_ids[-1]
+                if self.prefix_cache is not None:
+                    # publish the restored PROMPT blocks for reuse,
+                    # mirroring the post-prefill register
+                    self.prefix_cache.register(
+                        req.prompt_token_ids, req.block_ids,
+                        req.num_cached,
+                    )
+                reason = req.check_stop(self.config.max_model_len)
+                if reason:
+                    self._finish(req, reason, finished)
 
     def _disable_stepstats(self, exc):
         """``obs.stepstats`` degradation: a crashing sampler is warned
@@ -2619,6 +2723,11 @@ class Engine:
                     req.block_ids += bm.allocate(1)
 
     def _preempt(self, req):
+        # restore-instead-of-recompute: snapshot the victim's cached
+        # blocks into the host tier BEFORE _release frees them; a
+        # successful spill makes the re-admission a host->device
+        # restore (no re-prefill) instead of a recompute
+        spilled = self._spill_request(req)
         self._release(req)
         req.state = RequestState.WAITING
         req.num_cached = 0
@@ -2630,8 +2739,137 @@ class Engine:
         req.timeline.preemptions += 1
         _flight.record(
             "serving", "preemption", engine=self.engine_id,
-            request_id=req.request_id,
+            request_id=req.request_id, spilled=spilled,
         )
+
+    # -- host spill tier (serving/spill.py) ----------------------------------
+    def _spill_request(self, req):
+        """Park ``req``'s cached KV blocks in the host tier as ONE
+        handle (all-or-nothing), keyed on the Request so re-admission
+        — here, or on a same-host survivor after ``release()`` — can
+        restore them. Best effort: any failure (tier disabled, nothing
+        cached, injected ``kv.spill`` fault, host budget) returns
+        False and the old free-and-recompute path applies unchanged.
+        A successful spill is re-ADMITted to the journal so the handle
+        key rides next to the emit cursor — a crash replay re-anchors
+        it against the disk tier."""
+        if self.spill is None or req.num_cached < 1:
+            return False
+        bm = self.block_manager
+        need = bm.blocks_needed(req.num_cached)
+        if need > len(req.block_ids):
+            return False
+        try:
+            snaps = [
+                self.pool.read_block(b) for b in req.block_ids[:need]
+            ]
+        except Exception as e:
+            # analysis: allow(broad-except) spill is an optimization:
+            # an unreadable pool (donation race, backend error) must
+            # degrade to plain recompute preemption, never crash
+            self.spill.note_spill_failure("request")
+            if not self._spill_warned:
+                self._spill_warned = True
+                warnings.warn(
+                    f"[serving] KV spill read failed "
+                    f"({type(e).__name__}: {e}); preemption degrades "
+                    "to recompute (warned once, counted)",
+                    stacklevel=2,
+                )
+            return False
+        key = f"req:{req.request_id}:{self._spill_seq}"
+        self._spill_seq += 1
+        if not self.spill.put(
+            key, snaps, self._spill_signature,
+            num_tokens=req.num_cached, cls="request",
+        ):
+            return False
+        req.spill_key = key
+        req.spill_tokens = req.num_cached
+        if self.journal is not None and not self._journal_replaying:
+            # latest-ADMIT-wins: this re-ADMIT carries both the emit
+            # cursor and the spill handle (journal "kv" field)
+            self.journal.admit(req)
+        return True
+
+    def _spill_restorable(self, req, tokens):
+        """Admission peek: the token count a spilled handle would
+        restore for ``req``, or None for the normal allocate+prefill
+        path. Validates the handle against the live tiers (host, disk,
+        same-process peers) and this engine's program family — a
+        PARTIAL restore leaves a suffix to prefill, which needs the
+        prefill_ext program."""
+        if self.spill is None or getattr(req, "spill_key", None) is None:
+            return None
+        n = int(getattr(req, "spill_tokens", 0) or 0)
+        if (n < 1 or n > len(tokens)
+                or (n < len(tokens) and not self._use_ext)
+                or (n >= len(tokens) and not req.output_token_ids)):
+            req.spill_key = None
+            return None
+        if not self.spill.has(req.spill_key, self._spill_signature):
+            # the tier LRU-dropped it (or a cross-host migration):
+            # recompute path, and stop re-peeking every step
+            req.spill_key = None
+            return None
+        return n
+
+    def _spill_restore(self, req, n_tokens):
+        """Write ``req``'s spilled handle back into its freshly
+        allocated blocks. True = restored (``num_cached`` may be set
+        to ``n_tokens``); False degrades to recompute — the blocks
+        stay allocated and the normal prefill rebuilds them. Runs
+        under the OOM guard: a RESOURCE_EXHAUSTED device write
+        reclaims cold prefix blocks (spilling them colder, to host)
+        and retries once before degrading."""
+        from .spill import is_resource_exhausted
+
+        t0 = time.perf_counter()
+        key, req.spill_key, req.spill_tokens = req.spill_key, None, 0
+        payload = self.spill.get(
+            key, self._spill_signature, pop=True
+        )
+        need = self.block_manager.blocks_needed(n_tokens)
+        if payload is None or len(payload) < need:
+            return False
+        for i, (block, snap) in enumerate(
+            zip(req.block_ids[:need], payload)
+        ):
+            try:
+                self.pool.write_block(block, snap)
+            except Exception as e:
+                # analysis: allow(broad-except) the memory-pressure
+                # degradation ladder: reclaim -> spill colder blocks
+                # -> recompute; admission never unwinds the step
+                if is_resource_exhausted(e) and self.prefix_cache \
+                        is not None:
+                    self.prefix_cache.reclaim(
+                        need - i, protect=req.block_ids
+                    )
+                    try:
+                        self.pool.write_block(block, snap)
+                        continue
+                    except Exception:
+                        # analysis: allow(broad-except) same ladder:
+                        # the retry exhausts it; recompute below
+                        pass
+                self.spill.note_restore_failure("request")
+                if not self._spill_warned:
+                    self._spill_warned = True
+                    warnings.warn(
+                        f"[serving] KV restore failed "
+                        f"({type(e).__name__}: {e}); degrading to "
+                        "recompute (warned once, counted)",
+                        stacklevel=2,
+                    )
+                return False
+        # goodput attribution: any residual prefill (partial handle)
+        # is real forward progress, not preemption waste
+        req.resume_cause = "restored"
+        self.spill.note_restored(
+            "request", payload, time.perf_counter() - t0
+        )
+        return True
 
     def _decode(self, finished):
         # one key per scheduler step, shared by isolation re-launches:
@@ -2991,6 +3229,12 @@ class Engine:
             req.slot = None
 
     def _finish(self, req, reason, finished):
+        if self.spill is not None and getattr(req, "spill_key", None):
+            # a parked handle for a request that will never resume is
+            # dead budget: release it now instead of waiting for LRU
+            self.spill.discard(req.spill_key)
+            req.spill_key = None
+            req.spill_tokens = 0
         if reason == "aborted" and self.stepstats is not None:
             # the client walked away from every token this request
             # emitted: reclassify them useful -> wasted in the ledger

@@ -340,6 +340,15 @@ def _register_view(fleet):
         tp_deg = MetricFamily(
             "paddle_tpu_fleet_replica_tp_degree", "gauge",
         )
+        # host spill tier per replica (serving/spill.py): occupancy
+        # and restore hit rate, so a fleet review sees which replicas
+        # are surviving pressure by swapping instead of recomputing
+        spill_bytes = MetricFamily(
+            "paddle_tpu_fleet_replica_spill_host_bytes", "gauge",
+        )
+        spill_hit = MetricFamily(
+            "paddle_tpu_fleet_replica_spill_restore_hit_rate", "gauge",
+        )
         for sup in fl.replicas:
             rl = {**label, "replica": sup.name}
             up.add(1.0 if sup.status == "healthy" else 0.0, rl)
@@ -353,10 +362,20 @@ def _register_view(fleet):
                 reclaimable.add(em.kv_reclaimable_blocks, rl)
                 headroom.add(em.kv_headroom_blocks, rl)
                 tp_deg.add(em.tp_degree, rl)
+                tier = getattr(eng, "spill", None)
+                if tier is not None:
+                    ts = tier.stats()
+                    spill_bytes.add(ts["host_bytes"], rl)
+                    if ts["restore_hit_rate"] is not None:
+                        spill_hit.add(ts["restore_hit_rate"], rl)
         fams += [
             up, restarts, pfx_hits, pfx_tokens, pfill, reclaimable,
             headroom, tp_deg,
         ]
+        if spill_bytes.samples:
+            fams.append(spill_bytes)
+        if spill_hit.samples:
+            fams.append(spill_hit)
         # replica lifecycle states, zero-filled over every state so a
         # scale event is a visible edge (0->1 spawning, 1->0 live, ...)
         # even on a fleet that has never scaled; released replicas are

@@ -172,15 +172,19 @@ def _fsync_dir(path):
 class ReplayEntry:
     """One unfinished request recovered from the journal."""
 
-    __slots__ = ("rid", "prompt", "params", "out", "ts", "tenant")
+    __slots__ = ("rid", "prompt", "params", "out", "ts", "tenant", "kv")
 
-    def __init__(self, rid, prompt, params, out, ts, tenant=None):
+    def __init__(self, rid, prompt, params, out, ts, tenant=None,
+                 kv=None):
         self.rid = rid          # request id (int or str, as journaled)
         self.prompt = prompt    # prompt token ids
         self.params = params    # SamplingParams dict (to_dict form)
         self.out = out          # tokens already emitted (the cursor)
         self.ts = ts            # wall-clock admission time (time.time)
         self.tenant = tenant    # QoS tenant id (None pre-QoS journals)
+        self.kv = kv            # [spill_key, spill_tokens] handle into
+        #                         the host spill tier, or None (pre-
+        #                         spill journals / never-spilled)
 
     def __repr__(self):
         return (
@@ -229,6 +233,13 @@ def restore_entries(journal, entries, build):
             req.output_token_ids = list(e.out)
             if getattr(e, "tenant", None) is not None:
                 req.tenant = e.tenant
+            kv = getattr(e, "kv", None)
+            if kv:
+                # re-anchor the host-spill handle: if the tier (or its
+                # disk third level) still holds the key, re-admission
+                # restores the KV instead of re-prefilling it
+                req.spill_key = kv[0]
+                req.spill_tokens = int(kv[1])
             if e.ts is not None:
                 # timeline coherence: anchor arrival at the journaled
                 # wall-clock admission (the same field the TTL math
@@ -356,6 +367,13 @@ class Journal:
         tenant = getattr(req, "tenant", None)
         if tenant is not None:
             rec["tn"] = tenant
+        # host-spill handle rides the ADMIT too: a re-admit after
+        # preempt/release journals [key, tokens] so a crash re-anchors
+        # the restore-instead-of-recompute path (latest ADMIT wins, so
+        # a consumed handle is naturally cleared by the next re-admit)
+        kv_key = getattr(req, "spill_key", None)
+        if kv_key is not None:
+            rec["kv"] = [kv_key, int(getattr(req, "spill_tokens", 0))]
         self._buffer.append(rec)
         self._urgent = True   # admissions are durable before dispatch
         self._open.add(_key(rid))
@@ -689,7 +707,7 @@ class Journal:
                         "sp": rec.get("sp", {}),
                         "out": list(rec.get("out", [])),
                         "ts": rec.get("ts"), "tn": rec.get("tn"),
-                        "fin": False,
+                        "kv": rec.get("kv"), "fin": False,
                     }
                     order.setdefault(k, seq)
                     seq += 1
@@ -731,6 +749,7 @@ class Journal:
             ReplayEntry(
                 entries[k]["rid"], entries[k]["p"], entries[k]["sp"],
                 entries[k]["out"], entries[k]["ts"], entries[k]["tn"],
+                entries[k]["kv"],
             )
             for k in unfinished
         ]

@@ -274,6 +274,132 @@ class KVPool:
         self.k = k
         self.v = v
 
+    # -- host spill primitives (serving/spill.py) ---------------------------
+    def block_signature(self):
+        """Stable wire form of the PER-BLOCK layout: layer count, block
+        size, quantization, and each leaf's block-slice shape/dtype.
+        Two pools with equal signatures can exchange spilled blocks
+        even when ``num_blocks`` or the shard layout differ (a
+        migration survivor's pool may be a different size or width);
+        the spill tier refuses a restore across differing signatures —
+        a miss, never a corruption."""
+        per = [
+            [list(s[:1] + s[2:]), str(d)]
+            for s, d in zip(self._shapes, self._dtypes)
+        ]
+        import json
+
+        return json.dumps(
+            [self.num_layers, self.block_size,
+             self.quant_dtype or "none", per],
+            separators=(",", ":"),
+        )
+
+    def read_block(self, block_id):
+        """Host snapshot of ONE block: ``(k_layers, v_layers)``, each a
+        tuple over layers of per-leaf numpy arrays shaped like a block
+        slice (``[num_kv_heads, block_size, head_dim]`` pages,
+        ``[num_kv_heads, block_size]`` scales). Sharded pools are read
+        PER SHARD via ``addressable_shards`` — never gathering a whole
+        plane through one device — and reassembled on host. The block
+        index is passed as a dynamic-slice operand, so the underlying
+        eager gather caches on shape alone (no per-block compile
+        churn, no tracked program family touched)."""
+        import jax
+        import numpy as np
+
+        b = int(block_id)
+
+        def one(x):
+            sl = jax.lax.dynamic_slice_in_dim(x, b, 1, axis=1)
+            return np.asarray(sl)[:, 0]
+
+        def leaf_block(a):
+            shards = getattr(a, "addressable_shards", None)
+            if shards and len(shards) > 1:
+                pieces = [(s.index, one(s.data)) for s in shards]
+                out = np.zeros(
+                    tuple(a.shape[:1]) + tuple(a.shape[2:]),
+                    pieces[0][1].dtype,
+                )
+                for idx, piece in pieces:
+                    out[(idx[0],) + tuple(idx[2:])] = piece
+                return out
+            return one(a)
+
+        def entry_block(entry):
+            return tuple(
+                leaf_block(leaf) for leaf in self._layer_leaves(entry)
+            )
+
+        return (
+            tuple(entry_block(e) for e in self.k),
+            tuple(entry_block(e) for e in self.v),
+        )
+
+    def write_block(self, block_id, snapshot):
+        """Write one host snapshot (from :meth:`read_block`, possibly
+        of a DIFFERENT pool with the same :meth:`block_signature`) into
+        block ``block_id`` — the spill tier's restore primitive.
+        Host-side eager data movement only: no tracked program family
+        is touched (the zero-new-compiled-programs contract), and each
+        leaf keeps its committed sharding (``device_put`` back onto the
+        original sharding — a resharded leaf would retrace the serving
+        programs). The updated arrays are adopted via :meth:`rebind`,
+        re-validating the whole layout on every restore. The copy is
+        bytewise: no arithmetic touches the payload, so a restored
+        block is byte-identical to the block that was spilled."""
+        import jax
+        import numpy as np
+
+        b = int(block_id)
+        if not 0 <= b < self.num_blocks:
+            raise ValueError(
+                f"write_block: block {b} outside pool of "
+                f"{self.num_blocks}"
+            )
+        k_snap, v_snap = snapshot
+        if len(k_snap) != self.num_layers or len(v_snap) != self.num_layers:
+            raise ValueError(
+                f"write_block: snapshot has {len(k_snap)}/{len(v_snap)} "
+                f"k/v layers, pool has {self.num_layers}"
+            )
+
+        def write_entry(entry, leaves_host):
+            leaves = self._layer_leaves(entry)
+            if len(leaves_host) != len(leaves):
+                raise ValueError(
+                    f"write_block: snapshot layer has "
+                    f"{len(leaves_host)} leaves, pool expects "
+                    f"{len(leaves)}"
+                )
+            out = []
+            for a, host in zip(leaves, leaves_host):
+                host = np.asarray(host)
+                want = tuple(a.shape[:1]) + tuple(a.shape[2:])
+                if tuple(host.shape) != want or host.dtype != a.dtype:
+                    raise ValueError(
+                        f"write_block: snapshot leaf "
+                        f"{tuple(host.shape)}/{host.dtype} does not "
+                        f"match pool block layout {want}/{a.dtype}"
+                    )
+                upd = jax.lax.dynamic_update_slice_in_dim(
+                    a, jnp.asarray(host)[:, None], b, axis=1
+                )
+                sharding = getattr(a, "sharding", None)
+                if sharding is not None:
+                    upd = jax.device_put(upd, sharding)
+                out.append(upd)
+            return out[0] if self.quant_dtype is None else tuple(out)
+
+        new_k = tuple(
+            write_entry(e, s) for e, s in zip(self.k, k_snap)
+        )
+        new_v = tuple(
+            write_entry(e, s) for e, s in zip(self.v, v_snap)
+        )
+        self.rebind(new_k, new_v)
+
     def nbytes(self):
         import jax
 

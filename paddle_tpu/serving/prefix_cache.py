@@ -116,7 +116,8 @@ class PrefixCache:
     leaf entries oldest-first.
     """
 
-    def __init__(self, block_manager, capacity_blocks, metrics=None):
+    def __init__(self, block_manager, capacity_blocks, metrics=None,
+                 spill=None, pool=None):
         if capacity_blocks < 1:
             raise ValueError(
                 f"capacity_blocks must be >= 1, got {capacity_blocks}"
@@ -128,6 +129,16 @@ class PrefixCache:
         # first; lookup/register touches move entries to the end)
         self._entries: OrderedDict = OrderedDict()
         self._metrics = metrics
+        # host spill tier (serving/spill.py): eviction DEMOTES full
+        # chain blocks into it instead of destroying their bytes, and
+        # lookup() restores a spilled chain continuation into fresh
+        # pool blocks. Needs the physical pool for block reads/writes;
+        # without both, eviction behaves exactly as before.
+        self._spill = spill if pool is not None else None
+        self._pool = pool
+        self._sig = pool.block_signature() if (
+            spill is not None and pool is not None
+        ) else None
         self._digest_cache = ()   # rebuilt lazily after insert/evict
 
     def __len__(self):
@@ -159,16 +170,29 @@ class PrefixCache:
         token is always left to prefill). Returns a :class:`PrefixMatch`
         or ``None``.
 
-        Pure read: no counters move and no LRU position changes — an
-        admission that stays blocked retries the lookup every step, and
-        only the attempt that actually forks the blocks may count as a
-        hit (:meth:`commit`) or deserve an LRU touch."""
+        Pure read against the DEVICE entries: no counters move and no
+        LRU position changes — an admission that stays blocked retries
+        the lookup every step, and only the attempt that actually
+        forks the blocks may count as a hit (:meth:`commit`) or
+        deserve an LRU touch. The one side effect is the spill tier:
+        a chain walk that runs off the cached entries into a SPILLED
+        continuation restores it into fresh pool blocks right here
+        (idempotent — the restored entry is a plain cached entry, so
+        a blocked retry hits it in ``_entries`` next time). A restore
+        may transiently push the entry count past ``capacity_blocks``;
+        the next :meth:`register`/:meth:`reclaim` settles it (evicting
+        mid-walk would free blocks this very match is about to fork)."""
         matched = []
-        for digest, _i in self._chain(tokens):
+        parent = None
+        for digest, i in self._chain(tokens):
             e = self._entries.get(digest)
+            if (e is None and self._spill is not None
+                    and i * self._bs < limit):
+                e = self._restore(digest, parent)
             if e is None:
                 break
             matched.append(e)
+            parent = e
         cache_len = min(len(matched) * self._bs, int(limit))
         if cache_len <= 0:
             return None
@@ -220,12 +244,78 @@ class PrefixCache:
             parent = e
         self._enforce_budget()
 
+    # -- spill tier ----------------------------------------------------------
+    def _restore(self, digest, parent):
+        """Re-materialize a spilled chain block into a fresh pool
+        block: one host->device write, byte-identical to the block
+        that was evicted. Returns the new (cache-owned) entry, or
+        ``None`` on any miss — tier miss, no free pool block, an
+        injected ``kv.restore`` fault, a RESOURCE_EXHAUSTED device
+        write — in which case the chain walk stops and admission takes
+        the old recompute path unchanged."""
+        key = f"prefix:{digest.hex()}"
+        if not self._spill.has(key, self._sig):
+            return None
+        if not self._bm.can_allocate(1):
+            # allocation pressure: a restore must never deepen it
+            return None
+        import time
+
+        t0 = time.perf_counter()
+        payload = self._spill.get(key, self._sig, pop=True)
+        if payload is None:
+            return None
+        [block] = self._bm.allocate(1)   # the cache's own reference
+        try:
+            self._pool.write_block(block, payload[0])
+        except Exception:
+            # analysis: allow(broad-except) the degradation contract:
+            # a failed device write (incl. RESOURCE_EXHAUSTED) frees
+            # the block and falls back to recompute — never fatal
+            self._bm.free([block])
+            self._spill.note_restore_failure("prefix")
+            return None
+        e = _Entry(digest, block, parent)
+        self._entries[digest] = e
+        self._digest_cache = None
+        if parent is not None:
+            parent.children += 1
+        self._spill.note_restored(
+            "prefix", payload, time.perf_counter() - t0
+        )
+        if self._metrics is not None:
+            self._metrics.prefix_restores += 1
+        return e
+
+    def _demote(self, e):
+        """Best-effort block demotion at eviction: snapshot the block
+        into the host tier under its chain key. Any failure (injected
+        ``kv.spill`` fault, budget, unreadable device block) means the
+        block simply dies the way it did before the tier existed."""
+        try:
+            snap = self._pool.read_block(e.block)
+        except Exception:
+            # analysis: allow(broad-except) demotion is an
+            # optimization: a failed device read degrades to the old
+            # free-and-recompute eviction, counted on the tier
+            self._spill.note_spill_failure("prefix")
+            return
+        self._spill.put(
+            f"prefix:{e.digest.hex()}", [snap], self._sig,
+            num_tokens=self._bs, cls="prefix",
+        )
+
     # -- eviction / reclaim --------------------------------------------------
     def _evict(self, digest):
         e = self._entries.pop(digest)
         self._digest_cache = None
         if e.parent is not None:
             e.parent.children -= 1
+        if self._spill is not None:
+            # demote instead of destroy: the bytes move to the host
+            # tier (keyed by chain digest) BEFORE the device block is
+            # freed; a later chain match restores them
+            self._demote(e)
         self._bm.free([e.block])
         if self._metrics is not None:
             self._metrics.prefix_evictions += 1

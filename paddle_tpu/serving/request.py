@@ -307,10 +307,17 @@ class Request:
         # journal (the emit cursor; journal.admit/emit own it)
         self.journal_cursor = 0
         # goodput attribution for a forced re-prefill: "preempt"
-        # (in-engine recompute preemption) or "migration" (fleet
-        # failover/scale-down resume) — the step observatory's ledger
-        # classifies the recomputed tokens by this
+        # (in-engine recompute preemption), "migration" (fleet
+        # failover/scale-down resume), or "restored" (KV rebuilt from
+        # the host spill tier — counted useful, not wasted) — the step
+        # observatory's ledger classifies the recomputed tokens by this
         self.resume_cause = None
+        # host spill tier handle (serving/spill.py): set when this
+        # request's KV blocks were swapped to host RAM at preemption/
+        # release; re-admission restores them instead of re-prefilling.
+        # Journaled in ADMIT ("kv") so a crash re-anchors the handle.
+        self.spill_key = None
+        self.spill_tokens = 0
         # multi-tenant QoS attribution (serving/qos.py); None for
         # in-process callers. Journaled in ADMIT ("tn") so replay
         # restores per-tenant accounting.

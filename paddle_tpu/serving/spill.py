@@ -1,0 +1,575 @@
+"""Hierarchical KV spill tier: host-RAM block swap under the paged pool.
+
+Device memory is the serving hard ceiling (the bench's single-chip
+RESOURCE_EXHAUSTED wall), and before this module every memory-pressure
+event was *destructive*: an LRU-evicted prefix-cache chain died, a
+preempted request recomputed its whole KV from scratch (the goodput
+ledger's ``preempt_recompute`` class), and an allocation failure was a
+crash. :class:`HostSpillTier` turns all three into survivable
+degradations by adding a host-RAM tier under the device pool:
+
+  * **Prefix-chain spill** — ``PrefixCache`` eviction demotes full
+    chain blocks here (keyed by chain digest) instead of freeing their
+    bytes; a later chain match restores them into fresh pool blocks,
+    byte-identical to the never-evicted path.
+  * **Restore-instead-of-recompute preemption** — ``Engine._preempt``
+    and ``Engine.release`` snapshot a victim's cached blocks here as
+    ONE handle; re-admission writes them back and skips the re-prefill
+    entirely. The handle key is journaled on the re-ADMIT record, so a
+    crash replay can re-anchor against the disk tier.
+  * **Disk third tier** — ``spill_dir=`` demotes host-LRU victims to
+    ``.npz`` files (compile-cache style, content-keyed filenames), and
+    serves misses from disk. Because prefix keys are content-derived
+    chain digests, a fresh process pointed at the same directory finds
+    the previous incarnation's warm chains with no journal involved.
+
+Payloads are nested tuples of numpy arrays exactly as
+``KVPool.read_block`` produces them (per layer, per k/v, per leaf —
+``(pages,)`` or ``(pages, scales)``), captured per-shard via
+``addressable_shards`` on sharded pools. Host numpy buffers stand in
+for pinned allocations (the restore ``device_put`` path is identical;
+a TPU build can swap the allocator without touching callers).
+
+Degradation contract (docs/resilience.md): the fault sites ``kv.spill``
+and ``kv.restore`` fire at the head of :meth:`HostSpillTier.put` /
+:meth:`HostSpillTier.get`; an injected failure warns ONCE, counts, and
+returns False/None — the caller falls back to the pre-spill behavior
+(free-and-recompute), never crashes, never leaks a block.
+
+Thread safety: the tier is mutated by the scheduler thread and read by
+the metrics scrape thread (``stats()`` / the collector view), so every
+entry-map and counter access holds ``self._lock``.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import warnings
+import weakref
+from collections import OrderedDict
+
+from ..resilience import faults
+
+__all__ = [
+    "HostSpillTier", "is_resource_exhausted", "payload_nbytes",
+    "register_spill_view",
+]
+
+# spill classes: what kind of state a key holds. "prefix" entries are
+# chain-digest-keyed single blocks; "request" entries are whole-request
+# handles (every cached block of one preempted/released request).
+_CLASSES = ("prefix", "request")
+
+# substrings that identify a backend out-of-memory failure across
+# jax/XLA error flavors (XlaRuntimeError renders the gRPC status name)
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "out of memory", "OUT_OF_MEMORY")
+
+# live tiers in this process, for same-host handle exchange: a fleet
+# migration releases on one engine and resumes on another, and when
+# both share the process their host RAM is one resource — the survivor
+# may restore a handle the source tier holds. WeakSet: a dead engine's
+# tier must not be pinned by the registry.
+_TIERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def is_resource_exhausted(exc):
+    """True when ``exc`` looks like a backend allocation failure —
+    the trigger for the memory-pressure degradation ladder
+    (reclaim -> spill colder blocks -> shed) instead of a crash."""
+    text = f"{type(exc).__name__}: {exc}"
+    return any(m in text for m in _OOM_MARKERS)
+
+
+def payload_nbytes(payload):
+    """Host bytes of one spill payload (a list of per-block snapshots
+    from ``KVPool.read_block``)."""
+    total = 0
+    for snap in payload:
+        for side in snap:                 # (k_layers, v_layers)
+            for layer in side:            # per-layer leaf tuple
+                for leaf in layer:
+                    total += leaf.nbytes
+    return total
+
+
+class _SpillEntry:
+    __slots__ = ("key", "cls", "payload", "nbytes", "signature",
+                 "num_tokens")
+
+    def __init__(self, key, cls, payload, nbytes, signature, num_tokens):
+        self.key = key
+        self.cls = cls
+        self.payload = payload        # None when demoted to disk only
+        self.nbytes = nbytes
+        self.signature = signature
+        self.num_tokens = num_tokens
+
+
+class HostSpillTier:
+    """Bounded host-RAM store of spilled KV blocks, its own LRU.
+
+    ``capacity_bytes`` bounds the host payload bytes held at once;
+    exceeding it evicts oldest entries first — to the ``spill_dir``
+    disk tier when one is configured, otherwise they are dropped (the
+    caller's recompute path still exists; the tier is an optimization,
+    never the correctness story). Keys are plain strings
+    (``"prefix:<digest-hex>"`` / ``"req:<rid>:<seq>"``) so they ride
+    journal records unchanged; every entry carries the pool's
+    ``block_signature()`` and a restore against a different layout is
+    a miss, not a corruption.
+    """
+
+    def __init__(self, capacity_bytes, spill_dir=None, engine_id="0"):
+        capacity_bytes = int(capacity_bytes)
+        if capacity_bytes < 1:
+            raise ValueError(
+                f"host_spill_bytes must be >= 1, got {capacity_bytes}"
+            )
+        self.capacity_bytes = capacity_bytes
+        self.spill_dir = str(spill_dir) if spill_dir is not None else None
+        if self.spill_dir is not None:
+            os.makedirs(self.spill_dir, exist_ok=True)
+        self.engine_id = str(engine_id)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()  # key -> _SpillEntry
+        self._host_bytes = 0
+        # counters (read by the scrape thread through stats())
+        self.spilled_blocks = dict.fromkeys(_CLASSES, 0)
+        self.spilled_bytes = dict.fromkeys(_CLASSES, 0)
+        self.restored_blocks = dict.fromkeys(_CLASSES, 0)
+        self.restored_bytes = dict.fromkeys(_CLASSES, 0)
+        self.restore_hits = 0
+        self.restore_misses = 0
+        self.spill_errors = 0
+        self.restore_errors = 0
+        self.host_evictions = 0
+        self.disk_writes = 0
+        self.disk_reads = 0
+        self.disk_errors = 0
+        self.restore_seconds_total = 0.0
+        self.restores = 0
+        self._spill_warned = False
+        self._restore_warned = False
+        _TIERS.add(self)
+
+    # -- core API ------------------------------------------------------------
+    def put(self, key, payload, signature, num_tokens=0, cls="prefix"):
+        """Admit one spill payload under ``key``. Returns True when the
+        bytes are safely in the host (or disk) tier — only then may the
+        caller treat the device blocks as restorable. False means the
+        old destructive path applies (injected ``kv.spill`` fault, a
+        payload larger than the whole budget with no disk tier, an
+        unwritable disk tier): warn-once + counted, never raised."""
+        nbytes = payload_nbytes(payload)
+        try:
+            faults.fire("kv.spill", key=key, cls=cls, nbytes=nbytes)
+        except Exception as e:
+            # analysis: allow(broad-except) the degradation contract:
+            # an injected spill failure must fall back to the
+            # free-and-recompute path, never crash the step
+            self._degrade("spill", e)
+            return False
+        with self._lock:
+            if nbytes > self.capacity_bytes and self.spill_dir is None:
+                self.spill_errors += 1
+                return False
+            old = self._entries.pop(key, None)
+            if old is not None and old.payload is not None:
+                self._host_bytes -= old.nbytes
+            entry = _SpillEntry(
+                key, cls, payload, nbytes, signature, int(num_tokens)
+            )
+            if nbytes > self.capacity_bytes:
+                # bigger than the whole host budget: straight to disk
+                if not self._disk_write(entry):
+                    self.spill_errors += 1
+                    return False
+                entry.payload = None
+            else:
+                self._host_bytes += nbytes
+            self._entries[key] = entry
+            self.spilled_blocks[cls] = (
+                self.spilled_blocks.get(cls, 0) + len(payload)
+            )
+            self.spilled_bytes[cls] = (
+                self.spilled_bytes.get(cls, 0) + nbytes
+            )
+            self._enforce_budget()
+            return True
+
+    def get(self, key, signature, pop=False):
+        """Fetch a payload for restore. Returns the payload or None
+        (miss / signature mismatch / injected ``kv.restore`` fault /
+        unreadable disk entry) — None means the caller recomputes.
+        Checks this tier (host then disk), then the other live tiers
+        in the process (same-host migration hands a handle from the
+        source engine's tier to the survivor's)."""
+        try:
+            faults.fire("kv.restore", key=key)
+        except Exception as e:
+            # analysis: allow(broad-except) the degradation contract:
+            # an injected restore failure must fall back to the
+            # recompute path, never crash admission
+            self._degrade("restore", e)
+            return None
+        payload = self._get_local(key, signature, pop)
+        if payload is None:
+            for tier in list(_TIERS):
+                if tier is self:
+                    continue
+                payload = tier._get_local(key, signature, pop)
+                if payload is not None:
+                    break
+        with self._lock:
+            if payload is None:
+                self.restore_misses += 1
+            else:
+                self.restore_hits += 1
+        return payload
+
+    def has(self, key, signature):
+        """Cheap restorability peek (no fault fire, no hit/miss
+        accounting): does any live tier — or this tier's disk — hold
+        ``key`` under a matching pool signature?"""
+        if self._has_local(key, signature):
+            return True
+        return any(
+            tier is not self and tier._has_local(key, signature)
+            for tier in list(_TIERS)
+        )
+
+    def discard(self, key):
+        """Drop ``key`` if held (host and disk); idempotent."""
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is not None and e.payload is not None:
+                self._host_bytes -= e.nbytes
+        self._disk_remove(key)
+
+    def note_restored(self, cls, payload, seconds):
+        """Book a COMPLETED restore (payload fetched AND written back
+        into the pool) — restored blocks/bytes only count once the
+        device write succeeded, so the counters never overstate."""
+        nbytes = payload_nbytes(payload)
+        with self._lock:
+            self.restored_blocks[cls] = (
+                self.restored_blocks.get(cls, 0) + len(payload)
+            )
+            self.restored_bytes[cls] = (
+                self.restored_bytes.get(cls, 0) + nbytes
+            )
+            self.restore_seconds_total += float(seconds)
+            self.restores += 1
+
+    def note_restore_failure(self, cls):
+        """A fetched payload failed its device write (OOM-degraded or
+        torn): counted here so ``restore_errors`` covers both halves
+        of the path."""
+        with self._lock:
+            self.restore_errors += 1
+
+    def note_spill_failure(self, cls):
+        """A device-side block read failed before ``put`` — counted so
+        the spill error total covers the whole demotion path."""
+        with self._lock:
+            self.spill_errors += 1
+
+    # -- internals -----------------------------------------------------------
+    def _get_local(self, key, signature, pop):
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e.signature != signature:
+                return None
+            if e is not None and e.payload is not None:
+                payload = e.payload
+                if pop:
+                    self._entries.pop(key)
+                    self._host_bytes -= e.nbytes
+                    self._disk_remove(key)
+                else:
+                    self._entries.move_to_end(key)
+                return payload
+        # disk tier (entry demoted, or written by a dead incarnation)
+        payload = self._disk_read(key, signature)
+        if payload is not None and pop:
+            self._disk_remove(key)
+            with self._lock:
+                e = self._entries.pop(key, None)
+                if e is not None and e.payload is not None:
+                    self._host_bytes -= e.nbytes
+        return payload
+
+    def _has_local(self, key, signature):
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                return e.signature == signature
+        if self.spill_dir is None:
+            return False
+        return os.path.exists(self._disk_path(key))
+
+    def _enforce_budget(self):
+        """Caller holds the lock. Oldest-first host eviction down to
+        ``capacity_bytes``; victims demote to disk when configured."""
+        while self._host_bytes > self.capacity_bytes and self._entries:
+            victim = None
+            for key, e in self._entries.items():   # oldest first
+                if e.payload is not None:
+                    victim = key
+                    break
+            if victim is None:
+                break
+            e = self._entries[victim]
+            if self.spill_dir is not None and self._disk_write(e):
+                e.payload = None           # demoted, key stays findable
+                self._entries.move_to_end(victim)
+            else:
+                self._entries.pop(victim)
+            self._host_bytes -= e.nbytes
+            self.host_evictions += 1
+
+    def _degrade(self, stage, exc):
+        with self._lock:
+            if stage == "spill":
+                self.spill_errors += 1
+                warned, self._spill_warned = self._spill_warned, True
+            else:
+                self.restore_errors += 1
+                warned, self._restore_warned = self._restore_warned, True
+        if not warned:
+            warnings.warn(
+                f"[spill] kv.{stage} failed "
+                f"({type(exc).__name__}: {exc}); degrading to the "
+                "recompute path (warned once, counted in "
+                f"{stage}_errors)",
+                stacklevel=3,
+            )
+
+    # -- disk third tier -----------------------------------------------------
+    def _disk_path(self, key):
+        name = hashlib.sha256(key.encode()).hexdigest()[:32]
+        return os.path.join(self.spill_dir, f"kv-{name}.npz")
+
+    def _disk_write(self, entry):
+        """Caller holds the lock (rare path: demotion/oversize only).
+        compilecache-style: write to a temp name, rename into place —
+        a SIGKILL mid-write leaves no half-entry under the real key."""
+        if self.spill_dir is None or entry.payload is None:
+            return False
+        import numpy as np
+
+        path = self._disk_path(entry.key)
+        arrays = {}
+        structure = []                 # per-block (k, v) leaf counts
+        i = 0
+        for snap in entry.payload:
+            sides = []
+            for side in snap:
+                layers = []
+                for layer in side:
+                    layers.append(len(layer))
+                    for leaf in layer:
+                        arrays[f"a{i}"] = leaf
+                        i += 1
+                sides.append(layers)
+            structure.append(sides)
+        meta = json.dumps({
+            "signature": entry.signature, "cls": entry.cls,
+            "num_tokens": entry.num_tokens, "structure": structure,
+        })
+        tmp = f"{path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, meta=np.frombuffer(
+                    meta.encode(), dtype=np.uint8
+                ), **arrays)
+            os.replace(tmp, path)
+            self.disk_writes += 1
+            return True
+        except Exception:
+            # analysis: allow(broad-except) unwritable disk tier: the
+            # entry just dies like it did before the tier existed
+            self.disk_errors += 1
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
+
+    def _disk_read(self, key, signature):
+        if self.spill_dir is None:
+            return None
+        import numpy as np
+
+        path = self._disk_path(key)
+        if not os.path.exists(path):
+            return None
+        try:
+            with np.load(path) as z:
+                meta = json.loads(bytes(z["meta"]).decode())
+                if meta["signature"] != signature:
+                    return None
+                payload = []
+                i = 0
+                for sides in meta["structure"]:
+                    snap = []
+                    for layers in sides:
+                        side = []
+                        for n in layers:
+                            side.append(tuple(
+                                z[f"a{i + j}"] for j in range(n)
+                            ))
+                            i += n
+                        snap.append(tuple(side))
+                    payload.append(tuple(snap))
+            with self._lock:
+                self.disk_reads += 1
+            return payload
+        except Exception:
+            # analysis: allow(broad-except) a torn/alien file is a
+            # miss (recompute path), never a crash
+            with self._lock:
+                self.disk_errors += 1
+            return None
+
+    def _disk_remove(self, key):
+        if self.spill_dir is None:
+            return
+        try:
+            os.remove(self._disk_path(key))
+        except OSError:
+            pass
+
+    # -- introspection -------------------------------------------------------
+    def disk_tokens(self, key):
+        """Token count recorded with a disk entry (crash re-anchor
+        uses the journaled count; this is the cross-check)."""
+        if self.spill_dir is None:
+            return None
+        import numpy as np
+
+        path = self._disk_path(key)
+        if not os.path.exists(path):
+            return None
+        try:
+            with np.load(path) as z:
+                return json.loads(
+                    bytes(z["meta"]).decode()
+                ).get("num_tokens")
+        except Exception:
+            # analysis: allow(broad-except) introspection must mirror
+            # _disk_read's miss-not-crash contract
+            return None
+
+    def stats(self):
+        """Snapshot for ``Engine.health()`` / the collector view (one
+        lock hold; every value is a plain number)."""
+        with self._lock:
+            hits, misses = self.restore_hits, self.restore_misses
+            lookups = hits + misses
+            return {
+                "host_bytes": self._host_bytes,
+                "host_capacity_bytes": self.capacity_bytes,
+                "host_entries": sum(
+                    1 for e in self._entries.values()
+                    if e.payload is not None
+                ),
+                "disk_entries": sum(
+                    1 for e in self._entries.values()
+                    if e.payload is None
+                ),
+                "spilled_blocks": dict(self.spilled_blocks),
+                "spilled_bytes": dict(self.spilled_bytes),
+                "restored_blocks": dict(self.restored_blocks),
+                "restored_bytes": dict(self.restored_bytes),
+                "restore_hits": hits,
+                "restore_misses": misses,
+                "restore_hit_rate": (
+                    hits / lookups if lookups else None
+                ),
+                "restore_ms_mean": (
+                    1e3 * self.restore_seconds_total / self.restores
+                    if self.restores else None
+                ),
+                "restores": self.restores,
+                "restore_seconds_total": self.restore_seconds_total,
+                "spill_errors": self.spill_errors,
+                "restore_errors": self.restore_errors,
+                "host_evictions": self.host_evictions,
+                "disk_writes": self.disk_writes,
+                "disk_reads": self.disk_reads,
+                "disk_errors": self.disk_errors,
+            }
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+            self._host_bytes = 0
+
+
+def register_spill_view(tier, engine_id, registry=None):
+    """Pull-time collector over one spill tier — the
+    ``paddle_tpu_serving_spill_*`` family. Weakref: a collected
+    engine's tier unregisters itself at the next scrape, mirroring
+    EngineMetrics/StepStats views."""
+    from ..observability import MetricFamily, get_registry
+
+    ref = weakref.ref(tier)
+    label = {"engine": str(engine_id)}
+
+    def collect():
+        t = ref()
+        if t is None:
+            return None
+        s = t.stats()
+        fams = [
+            MetricFamily(
+                "paddle_tpu_serving_spill_host_bytes", "gauge",
+            ).add(s["host_bytes"], label),
+            MetricFamily(
+                "paddle_tpu_serving_spill_host_capacity_bytes", "gauge",
+            ).add(s["host_capacity_bytes"], label),
+            MetricFamily(
+                "paddle_tpu_serving_spill_host_entries", "gauge",
+            ).add(s["host_entries"], label),
+        ]
+        spilled_b = MetricFamily(
+            "paddle_tpu_serving_spill_spilled_blocks_total", "counter",
+        )
+        spilled_y = MetricFamily(
+            "paddle_tpu_serving_spill_spilled_bytes_total", "counter",
+        )
+        restored_b = MetricFamily(
+            "paddle_tpu_serving_spill_restored_blocks_total", "counter",
+        )
+        restored_y = MetricFamily(
+            "paddle_tpu_serving_spill_restored_bytes_total", "counter",
+        )
+        for cls in _CLASSES:
+            cl = {**label, "class": cls}
+            spilled_b.add(s["spilled_blocks"].get(cls, 0), cl)
+            spilled_y.add(s["spilled_bytes"].get(cls, 0), cl)
+            restored_b.add(s["restored_blocks"].get(cls, 0), cl)
+            restored_y.add(s["restored_bytes"].get(cls, 0), cl)
+        fams += [spilled_b, spilled_y, restored_b, restored_y]
+        if s["restore_hit_rate"] is not None:
+            fams.append(MetricFamily(
+                "paddle_tpu_serving_spill_restore_hit_rate", "gauge",
+            ).add(s["restore_hit_rate"], label))
+        errors = MetricFamily(
+            "paddle_tpu_serving_spill_errors_total", "counter",
+        )
+        errors.add(s["spill_errors"], {**label, "stage": "spill"})
+        errors.add(s["restore_errors"], {**label, "stage": "restore"})
+        fams.append(errors)
+        return fams
+
+    (registry or get_registry()).register_collector(
+        f"serving.spill.{engine_id}", collect
+    )

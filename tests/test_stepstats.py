@@ -102,6 +102,18 @@ class TestStepStatsUnit:
         assert st.wasted_aborted_tokens == 5
         assert st.goodput_fraction() == 10 / 24
 
+    def test_restored_cause_counts_useful(self):
+        """A host-spill restore (serving/spill.py) makes the residual
+        prefill real forward progress: cause="restored" lands in
+        useful, not preempt_recompute."""
+        st = StepStats(backend="cpu")
+        st.begin_step()
+        st.note_prefill(6, cause="restored")
+        st.note_prefill(4, cause="preempt")
+        st.end_step(occupancy=0.5)
+        assert st.useful_tokens == 6
+        assert st.wasted_preempt_tokens == 4
+
     def test_idle_step_skipped_but_gauges_refresh(self):
         st = StepStats(backend="cpu")
         st.begin_step()
@@ -265,6 +277,33 @@ class TestEngineIntegration:
         assert st.wasted_migration_tokens == 0
         _reconciles(engine)
         assert st.goodput_fraction() < 1.0
+
+    def test_goodput_spill_restore_reconciles(self, model):
+        """The SAME thrash mix as the preemption test, but with the
+        host spill tier on: every preemption resumes through a restore
+        instead of a recompute, so preempt_recompute collapses to zero
+        while the identity still closes exactly and greedy outputs
+        stay byte-identical to the oracle."""
+        rng = np.random.default_rng(7)
+        lens = [int(n) for n in rng.choice([4, 7, 10], 6)]
+        prompts = [rng.integers(1, 128, n).tolist() for n in lens]
+        max_new = [16 - n for n in lens]
+        engine = Engine(model, _cfg(
+            num_blocks=10, host_spill_bytes=64 * 1024 * 1024,
+        ))
+        outs = engine.generate(
+            prompts, [SamplingParams(max_new_tokens=k) for k in max_new]
+        )
+        assert engine.metrics.preemptions >= 1
+        for o, p, k in zip(outs, prompts, max_new):
+            assert o.token_ids == _generate_oracle(model, p, k)
+        st = engine.stepstats
+        tier = engine.spill.stats()
+        assert tier["restored_blocks"]["request"] > 0
+        # restores replaced every recompute the thrash would have cost
+        assert st.wasted_preempt_tokens == 0
+        assert st.wasted_migration_tokens == 0
+        _reconciles(engine)
 
     def test_goodput_migration_reconciles(self, model):
         """release() on one engine + resume() on another (the fleet
